@@ -1,0 +1,1 @@
+from .tpu_accelerator import TPUAccelerator, get_accelerator, set_accelerator

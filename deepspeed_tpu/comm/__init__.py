@@ -1,0 +1,7 @@
+from .comm import (ReduceOp, init_distributed, is_initialized, get_world_size,
+                   get_rank, get_device_count, get_local_rank, barrier, all_reduce,
+                   inference_all_reduce, all_gather, reduce_scatter,
+                   all_to_all_single, broadcast, ppermute, send_recv_next,
+                   send_recv_prev, axis_index, axis_size, log_summary,
+                   configure)
+from .comms_logging import CommsLogger, get_comms_logger

@@ -1,0 +1,90 @@
+"""Comms volume logging (reference `deepspeed/utils/comms_logging.py`).
+
+Volumes are recorded at **trace time** — exact, since shapes are static under
+jit. Latency/busbw come from the jax profiler; here we account volume, op
+counts, and algorithmic bandwidth estimates per op type.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Optional
+
+
+def calc_bw_factor(op_name: str, n: int) -> float:
+    """Bus-bandwidth correction factor: volume_on_wire / payload (the
+    reference's get_bw, `utils/comms_logging.py:31`)."""
+    if n <= 1:
+        return 0.0
+    if op_name == "all_reduce":
+        return 2 * (n - 1) / n
+    if op_name in ("all_gather", "reduce_scatter", "all_to_all"):
+        return (n - 1) / n
+    return 1.0
+
+
+class CommsLogger:
+    def __init__(self, verbose: bool = False, debug: bool = False,
+                 prof_all: bool = True, prof_ops=None):
+        self.enabled = False
+        self.verbose = verbose
+        self.debug = debug
+        self.prof_all = prof_all
+        self.prof_ops = prof_ops or []
+        self.comms_dict: dict = defaultdict(lambda: defaultdict(
+            lambda: {"count": 0, "volume": 0}))
+
+    def configure(self, enabled: bool = True, **kw) -> None:
+        self.enabled = enabled
+        for k, v in kw.items():
+            if v is not None and hasattr(self, k):
+                setattr(self, k, v)
+
+    def record(self, op_name: str, nbytes: int, axis_name: str) -> None:
+        if not (self.prof_all or op_name in self.prof_ops):
+            return
+        rec = self.comms_dict[op_name][(nbytes, axis_name)]
+        rec["count"] += 1
+        rec["volume"] += nbytes
+        if self.verbose:
+            from ..utils.logging import logger
+            logger.info(f"comm op: {op_name} | axis: {axis_name} | "
+                        f"msg size: {nbytes} bytes (trace)")
+
+    def log_summary(self) -> str:
+        lines = [f"{'Op':<16}{'Axis':<12}{'Msg size':>12}{'Count':>8}"
+                 f"{'Total volume':>16}"]
+        for op_name, sizes in sorted(self.comms_dict.items()):
+            for (nbytes, axis_name), rec in sorted(sizes.items()):
+                lines.append(f"{op_name:<16}{axis_name:<12}{nbytes:>12}"
+                             f"{rec['count']:>8}{rec['volume']:>16}")
+        out = "\n".join(lines)
+        from ..utils.logging import logger
+        logger.info("\n" + out)
+        return out
+
+    def reset(self) -> None:
+        self.comms_dict.clear()
+
+
+_logger: Optional[CommsLogger] = None
+
+
+def get_comms_logger() -> Optional[CommsLogger]:
+    return _logger
+
+
+def configure(config=None, verbose: Optional[bool] = None, **kw) -> CommsLogger:
+    global _logger
+    if _logger is None:
+        _logger = CommsLogger()
+    if config is not None:  # CommsConfig from master config
+        # prof_ops given without prof_all means "profile only these"
+        prof_all = config.prof_all or not config.prof_ops
+        _logger.configure(enabled=True, verbose=config.verbose,
+                          debug=config.debug, prof_all=prof_all,
+                          prof_ops=config.prof_ops)
+    else:
+        if kw.get("prof_ops") and "prof_all" not in kw:
+            kw["prof_all"] = False
+        _logger.configure(enabled=True, verbose=verbose, **kw)
+    return _logger

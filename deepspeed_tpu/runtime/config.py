@@ -1,0 +1,442 @@
+"""Master configuration.
+
+One JSON/dict config is the spine of the framework, exactly as in the
+reference (`/root/reference/deepspeed/runtime/config.py:810`
+``_initialize_params``): every subsystem hangs its sub-config off this object.
+The schema accepts DeepSpeed-style JSON so existing configs port over, plus
+TPU-native blocks (``mesh``, ``sequence_parallel``) that have no reference
+equivalent.
+
+Batch-size reconciliation follows the reference's triple rule
+(`runtime/config.py:921-980`):
+    train_batch_size == micro_batch_per_device * gradient_accumulation_steps
+                        * data_parallel_world_size
+Given any two, the third is inferred; all three given must agree.
+"""
+from __future__ import annotations
+
+import json
+from enum import Enum
+from typing import Any, Dict, Optional
+
+from pydantic import Field, model_validator
+
+from .config_utils import ConfigModel, dict_raise_error_on_duplicate_keys
+from . import constants as C
+
+
+# ---------------------------------------------------------------------------
+# Precision
+# ---------------------------------------------------------------------------
+class FP16Config(ConfigModel):
+    """fp16 block — dynamic loss scaling semantics follow the reference
+    DynamicLossScaler (`runtime/fp16/loss_scaler.py:77`)."""
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = C.FP16_LOSS_SCALE_DEFAULT  # 0 => dynamic
+    initial_scale_power: int = C.FP16_INITIAL_SCALE_POWER_DEFAULT
+    loss_scale_window: int = C.FP16_LOSS_SCALE_WINDOW_DEFAULT
+    hysteresis: int = C.FP16_HYSTERESIS_DEFAULT
+    min_loss_scale: float = C.FP16_MIN_LOSS_SCALE_DEFAULT
+
+    @property
+    def dynamic(self) -> bool:
+        return self.enabled and self.loss_scale == 0
+
+
+class BF16Config(ConfigModel):
+    """bf16 block. On TPU bf16 is the native matmul dtype; fp32 master params
+    are kept like the reference BF16_Optimizer (`runtime/bf16_optimizer.py:38`)."""
+    enabled: bool = False
+    # Keep a full-precision master copy of params (rarely worth disabling).
+    master_weights: bool = True
+
+
+# ---------------------------------------------------------------------------
+# ZeRO
+# ---------------------------------------------------------------------------
+class OffloadDeviceEnum(str, Enum):
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class DeepSpeedZeroOffloadParamConfig(ConfigModel):
+    device: OffloadDeviceEnum = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = int(1e8)
+    max_in_cpu: int = int(1e9)
+    pin_memory: bool = False
+
+
+class DeepSpeedZeroOffloadOptimizerConfig(ConfigModel):
+    device: OffloadDeviceEnum = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = 4
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+
+    @property
+    def pipeline(self) -> bool:
+        return self.pipeline_read or self.pipeline_write
+
+
+class ZeroConfig(ConfigModel):
+    """zero_optimization block (reference: `runtime/zero/config.py`).
+
+    TPU interpretation: stages are sharding policies over the ``data`` mesh
+    axis, applied as `jax.sharding` annotations rather than runtime hooks.
+      stage 0 — pure DP: params/grads/opt-state replicated, grads psum'd.
+      stage 1 — optimizer state sharded over data axis.
+      stage 2 — + gradients reduce-scattered (psum_scatter) over data axis.
+      stage 3 — + parameters sharded (FSDP); XLA inserts just-in-time
+                 all-gathers, scheduled per layer block.
+    """
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = int(5e8)
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = int(5e8)
+    overlap_comm: Optional[bool] = None
+    load_from_fp32_weights: bool = True
+    elastic_checkpoint: bool = False
+    offload_param: Optional[DeepSpeedZeroOffloadParamConfig] = None
+    offload_optimizer: Optional[DeepSpeedZeroOffloadOptimizerConfig] = None
+    sub_group_size: int = int(1e9)
+    cpu_offload: Optional[bool] = None  # deprecated alias
+    cpu_offload_params: Optional[bool] = None  # deprecated alias
+    prefetch_bucket_size: int = int(5e7)
+    param_persistence_threshold: int = int(1e5)
+    model_persistence_threshold: int = int(1e14)  # pydantic int bounds: keep finite
+    max_live_parameters: int = int(1e9)
+    max_reuse_distance: int = int(1e9)
+    gather_16bit_weights_on_model_save: bool = False
+    ignore_unused_parameters: bool = True
+    legacy_stage1: bool = False
+    round_robin_gradients: bool = False
+    zero_hpz_partition_size: int = 1
+    # TPU-native: how many layer blocks to scan over for stage-3 gather
+    # scheduling (0 = let XLA decide; >0 = lax.scan over stacked blocks).
+    stage3_scan_layers: int = 0
+
+    @model_validator(mode="after")
+    def _resolve_deprecated(self):
+        if self.cpu_offload and self.offload_optimizer is None:
+            self.offload_optimizer = DeepSpeedZeroOffloadOptimizerConfig(
+                device=OffloadDeviceEnum.cpu)
+        if self.cpu_offload_params and self.offload_param is None:
+            self.offload_param = DeepSpeedZeroOffloadParamConfig(
+                device=OffloadDeviceEnum.cpu)
+        if not 0 <= self.stage <= 3:
+            raise ValueError(f"zero_optimization.stage must be 0..3, got {self.stage}")
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Optimizer / scheduler blocks
+# ---------------------------------------------------------------------------
+class OptimizerConfig(ConfigModel):
+    type: str = "AdamW"
+    params: Dict[str, Any] = Field(default_factory=dict)
+    legacy_fusion: bool = False
+
+
+class SchedulerConfig(ConfigModel):
+    type: str = "WarmupLR"
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Mesh (TPU-native block; replaces reference groups.py / mpu plumbing)
+# ---------------------------------------------------------------------------
+class MeshConfig(ConfigModel):
+    """Named-axis device mesh over ICI/DCN.
+
+    Replaces the reference's process-group topology
+    (`deepspeed/utils/groups.py`, `runtime/pipe/topology.py:243`) with a
+    declarative `jax.sharding.Mesh` spec. Axis sizes of -1 mean "absorb the
+    remaining devices" (at most one axis may be -1; ``data`` defaults to -1).
+    Axis order is outermost→innermost placement on the device torus; keep
+    ``model``/``sequence`` innermost so their collectives ride ICI.
+    """
+    data: int = -1
+    model: int = 1      # tensor parallel
+    pipe: int = 1       # pipeline stages
+    expert: int = 1     # MoE expert parallel (folded into data at runtime)
+    sequence: int = 1   # context/sequence parallel
+    # devices per host axis for multi-slice: "dcn_data" replicas over DCN
+    dcn_data: int = 1
+
+
+class PipelineConfig(ConfigModel):
+    """pipeline block (reference: PipelineEngine knobs on the engine config)."""
+    stages: str = "auto"
+    partition: str = "parameters"  # parameters | uniform | type:regex
+    seed_layers: bool = False
+    activation_checkpoint_interval: int = 0
+    pipe_partitioned: bool = True
+    grad_partitioned: bool = True
+    micro_batches: Optional[int] = None
+
+
+class SequenceParallelConfig(ConfigModel):
+    """TPU-native capability absent from the reference (SURVEY §5.7)."""
+    enabled: bool = False
+    mode: str = "ring"  # ring | ulysses
+    axis: str = "sequence"
+
+
+class TensorParallelConfig(ConfigModel):
+    enabled: bool = False
+    tp_size: int = 1
+    # auto-TP: shard any Dense whose name matches these patterns
+    autotp_size: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Aux subsystem blocks
+# ---------------------------------------------------------------------------
+class ActivationCheckpointingConfig(ConfigModel):
+    """Maps to jax.checkpoint/remat policies rather than the reference's
+    manual activation stash (`runtime/activation_checkpointing/`)."""
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # remat policy name: none|full|dots_saveable|nothing_saveable|custom
+    policy: str = "full"
+
+
+class AioConfig(ConfigModel):
+    """aio block (reference `runtime/swap_tensor/aio_config.py`)."""
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+class FlopsProfilerConfig(ConfigModel):
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class TensorBoardConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJobName"
+
+
+class WandbConfig(ConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed_tpu"
+
+
+class CSVConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJobName"
+
+
+class MonitorConfig(ConfigModel):
+    tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
+    wandb: WandbConfig = Field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+
+    @property
+    def enabled(self) -> bool:
+        return (self.tensorboard.enabled or self.wandb.enabled
+                or self.csv_monitor.enabled)
+
+
+class CheckpointConfig(ConfigModel):
+    tag_validation: str = "Warn"  # Ignore | Warn | Fail
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write_pipeline: bool = False
+    # async checkpointing via a background committer thread
+    async_save: bool = False
+
+
+class CommsConfig(ConfigModel):
+    verbose: bool = False
+    prof_all: bool = False
+    debug: bool = False
+    prof_ops: list = Field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Master config
+# ---------------------------------------------------------------------------
+class DeepSpeedConfig:
+    """Parses the master dict/JSON; exposes typed sub-configs.
+
+    Mirrors the surface of the reference `DeepSpeedConfig`
+    (`runtime/config.py:679`): scalar engine knobs as attributes, each
+    subsystem a typed config object.
+    """
+
+    def __init__(self, config: Any, world_size: Optional[int] = None):
+        if isinstance(config, str):
+            with open(config, "r") as f:
+                self._param_dict = json.load(
+                    f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        elif isinstance(config, dict):
+            self._param_dict = dict(config)
+        else:
+            raise ValueError(
+                f"Expected a dict or a json path, got {type(config)}")
+        self._world_size = world_size
+        self._initialize_params(self._param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    # -- parsing ----------------------------------------------------------
+    def _initialize_params(self, pd: dict) -> None:
+        g = pd.get
+        self.train_batch_size = g(C.TRAIN_BATCH_SIZE)
+        self.train_micro_batch_size_per_gpu = g(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+        self.gradient_accumulation_steps = g(C.GRADIENT_ACCUMULATION_STEPS)
+        self.steps_per_print = g(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = g(C.DUMP_STATE, False)
+        self.gradient_clipping = g(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
+        self.prescale_gradients = g(C.PRESCALE_GRADIENTS, C.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = g(C.GRADIENT_PREDIVIDE_FACTOR,
+                                           C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+        self.sparse_gradients_enabled = g(C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT)
+        self.wall_clock_breakdown = g(C.WALL_CLOCK_BREAKDOWN,
+                                      C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.communication_data_type = g(C.COMMUNICATION_DATA_TYPE)
+        self.disable_allgather = g(C.DISABLE_ALLGATHER, False)
+        self.memory_breakdown = g("memory_breakdown", False)
+
+        self.fp16 = FP16Config(**g(C.FP16, {}))
+        self.bf16 = BF16Config(**g(C.BF16, {}))
+        self.zero_config = ZeroConfig(**g(C.ZERO_OPTIMIZATION, {}))
+        self.optimizer = (OptimizerConfig(**pd[C.OPTIMIZER])
+                          if C.OPTIMIZER in pd else None)
+        self.scheduler = (SchedulerConfig(**pd[C.SCHEDULER])
+                          if C.SCHEDULER in pd else None)
+        self.mesh = MeshConfig(**g(C.MESH, {}))
+        self.pipeline = PipelineConfig(**g(C.PIPELINE, {}))
+        self.sequence_parallel = SequenceParallelConfig(**g(C.SEQUENCE_PARALLEL, {}))
+        self.tensor_parallel = TensorParallelConfig(**g(C.TENSOR_PARALLEL, {}))
+        self.activation_checkpointing = ActivationCheckpointingConfig(
+            **g(C.ACTIVATION_CHECKPOINTING, {}))
+        self.aio = AioConfig(**g(C.AIO, {}))
+        self.flops_profiler = FlopsProfilerConfig(**g(C.FLOPS_PROFILER, {}))
+        self.monitor = MonitorConfig(
+            tensorboard=TensorBoardConfig(**g(C.MONITOR_TENSORBOARD, {})),
+            wandb=WandbConfig(**g(C.MONITOR_WANDB, {})),
+            csv_monitor=CSVConfig(**g(C.MONITOR_CSV, {})),
+        )
+        self.checkpoint_config = CheckpointConfig(**g(C.CHECKPOINT, {}))
+        self.comms_config = CommsConfig(**g("comms_logger", {}))
+
+        # Late imports to avoid cycles; these blocks are parsed by their
+        # subsystems on first use.
+        self.elasticity_dict = g(C.ELASTICITY)
+        self.autotuning_dict = g(C.AUTOTUNING)
+        self.compression_dict = g(C.COMPRESSION_TRAINING)
+        self.data_efficiency_dict = g(C.DATA_EFFICIENCY)
+        self.curriculum_learning_legacy = g(C.CURRICULUM_LEARNING_LEGACY)
+
+    @property
+    def zero_enabled(self) -> bool:
+        return self.zero_config.stage > 0
+
+    @property
+    def zero_optimization_stage(self) -> int:
+        return self.zero_config.stage
+
+    @property
+    def precision_dtype(self) -> str:
+        if self.bf16.enabled:
+            return "bfloat16"
+        if self.fp16.enabled:
+            return "float16"
+        return "float32"
+
+    # -- batch reconciliation (reference config.py:921-980) ---------------
+    def _configure_train_batch_size(self) -> None:
+        if not hasattr(self, "_user_batch_triple"):
+            self._user_batch_triple = (self.train_batch_size,
+                                       self.train_micro_batch_size_per_gpu,
+                                       self.gradient_accumulation_steps)
+        tb, mb, gas = self._user_batch_triple
+        ws = self._world_size  # data-parallel world size; may be None pre-mesh
+
+        def _exact_div(num, den, what):
+            if num % den != 0:
+                raise ValueError(
+                    f"train_batch_size ({num}) is not divisible by {what} "
+                    f"({den}); the triple train_batch = micro_batch * "
+                    f"gradient_accumulation_steps * dp_world must hold exactly")
+            return num // den
+
+        if ws is not None:
+            if tb is not None and mb is not None and gas is not None:
+                if tb != mb * gas * ws:
+                    raise ValueError(
+                        f"train_batch_size ({tb}) != micro_batch ({mb}) * "
+                        f"gradient_accumulation_steps ({gas}) * dp_world ({ws})")
+            elif tb is not None and mb is not None:
+                gas = _exact_div(tb, mb * ws, "micro_batch * dp_world")
+            elif tb is not None and gas is not None:
+                mb = _exact_div(tb, gas * ws, "gradient_accumulation_steps * dp_world")
+            elif mb is not None and gas is not None:
+                tb = mb * gas * ws
+            elif tb is not None:
+                gas = 1
+                mb = _exact_div(tb, ws, "dp_world")
+            elif mb is not None:
+                gas = 1
+                tb = mb * ws
+            else:
+                raise ValueError(
+                    "Need at least train_batch_size or "
+                    "train_micro_batch_size_per_gpu in config")
+        else:
+            if gas is None:
+                gas = 1
+            if mb is None and tb is not None:
+                mb = tb  # resolved later once mesh known
+        self.train_batch_size = tb
+        self.train_micro_batch_size_per_gpu = mb
+        self.gradient_accumulation_steps = gas
+
+    def resolve_batch_sizes(self, dp_world: int) -> None:
+        """Re-run the triple reconciliation once the mesh is built."""
+        self._world_size = dp_world
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    def _do_sanity_check(self) -> None:
+        if self.fp16.enabled and self.bf16.enabled:
+            raise ValueError("fp16 and bf16 cannot both be enabled")
+        for v, name in ((self.train_batch_size, C.TRAIN_BATCH_SIZE),
+                        (self.train_micro_batch_size_per_gpu,
+                         C.TRAIN_MICRO_BATCH_SIZE_PER_GPU),
+                        (self.gradient_accumulation_steps,
+                         C.GRADIENT_ACCUMULATION_STEPS)):
+            if v is not None and v <= 0:
+                raise ValueError(f"{name} must be positive, got {v}")
+        z = self.zero_config
+        if z.stage < 3 and z.offload_param is not None and \
+                z.offload_param.device != OffloadDeviceEnum.none:
+            raise ValueError("offload_param requires ZeRO stage 3")
+
+    def print_config(self) -> str:
+        return json.dumps(self._param_dict, indent=2, sort_keys=True, default=str)

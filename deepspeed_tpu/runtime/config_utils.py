@@ -1,0 +1,48 @@
+"""Shared machinery for config models.
+
+Equivalent in role to the reference's ``DeepSpeedConfigModel``
+(`/root/reference/deepspeed/runtime/config_utils.py`): a pydantic base class
+with support for deprecated fields, "auto" placeholder values, and dict-style
+construction from a sub-block of the master JSON config.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from pydantic import BaseModel, ConfigDict
+
+AUTO = "auto"
+
+
+class ConfigModel(BaseModel):
+    """Base for all sub-config blocks.
+
+    - Unknown keys are rejected (catches typos the way the reference's
+      ``error on unrecognized`` behavior does).
+    - ``"auto"`` is tolerated for fields that declare it; resolution happens in
+      the engine once the mesh/model is known.
+    """
+
+    model_config = ConfigDict(extra="forbid", validate_assignment=True,
+                              populate_by_name=True, protected_namespaces=())
+
+    def __init__(self, strict: bool = False, **data: Any) -> None:
+        if not strict:  # drop None values so defaults apply
+            data = {k: v for k, v in data.items() if v is not None}
+        super().__init__(**data)
+
+
+def get_scalar_param(d: dict, key: str, default):
+    return d.get(key, default)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """json.load object_pairs_hook that rejects duplicate keys."""
+    d = dict(ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter: dict = {}
+        for k, _ in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        dupes = [k for k, n in counter.items() if n > 1]
+        raise ValueError(f"Duplicate keys in config: {dupes}")
+    return d

@@ -1,0 +1,92 @@
+"""Config keys and defaults for the master JSON config.
+
+Mirrors the configuration surface of the reference
+(`/root/reference/deepspeed/runtime/constants.py`) so a DeepSpeed user can
+bring their JSON config over unchanged; values are interpreted TPU-natively.
+"""
+
+#############################################
+# Batch-size triple
+#############################################
+TRAIN_BATCH_SIZE = "train_batch_size"
+TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
+GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
+
+#############################################
+# Optimizer / scheduler
+#############################################
+OPTIMIZER = "optimizer"
+SCHEDULER = "scheduler"
+MAX_GRAD_NORM = "max_grad_norm"
+
+#############################################
+# Precision
+#############################################
+FP16 = "fp16"
+BF16 = "bf16"
+AMP = "amp"
+
+#############################################
+# ZeRO
+#############################################
+ZERO_OPTIMIZATION = "zero_optimization"
+
+#############################################
+# Misc engine knobs
+#############################################
+GRADIENT_CLIPPING = "gradient_clipping"
+PRESCALE_GRADIENTS = "prescale_gradients"
+GRADIENT_PREDIVIDE_FACTOR = "gradient_predivide_factor"
+STEPS_PER_PRINT = "steps_per_print"
+WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
+DUMP_STATE = "dump_state"
+SPARSE_GRADIENTS = "sparse_gradients"
+COMMUNICATION_DATA_TYPE = "communication_data_type"
+DISABLE_ALLGATHER = "disable_allgather"
+
+#############################################
+# Subsystem config blocks
+#############################################
+ACTIVATION_CHECKPOINTING = "activation_checkpointing"
+AIO = "aio"
+FLOPS_PROFILER = "flops_profiler"
+MONITOR_TENSORBOARD = "tensorboard"
+MONITOR_WANDB = "wandb"
+MONITOR_CSV = "csv_monitor"
+ELASTICITY = "elasticity"
+AUTOTUNING = "autotuning"
+COMPRESSION_TRAINING = "compression_training"
+DATA_EFFICIENCY = "data_efficiency"
+CURRICULUM_LEARNING_LEGACY = "curriculum_learning"
+PIPELINE = "pipeline"
+MOE = "moe"
+SEQUENCE_PARALLEL = "sequence_parallel"
+MESH = "mesh"
+CHECKPOINT = "checkpoint"
+TENSOR_PARALLEL = "tensor_parallel"
+
+#############################################
+# Defaults
+#############################################
+TRAIN_BATCH_SIZE_DEFAULT = None
+TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT = None
+GRADIENT_ACCUMULATION_STEPS_DEFAULT = None
+STEPS_PER_PRINT_DEFAULT = 10
+GRADIENT_CLIPPING_DEFAULT = 0.0
+PRESCALE_GRADIENTS_DEFAULT = False
+GRADIENT_PREDIVIDE_FACTOR_DEFAULT = 1.0
+WALL_CLOCK_BREAKDOWN_DEFAULT = False
+SPARSE_GRADIENTS_DEFAULT = False
+
+# Loss-scaling defaults (fp16 block), same semantics as the reference
+# DynamicLossScaler (`runtime/fp16/loss_scaler.py:77`).
+FP16_LOSS_SCALE_DEFAULT = 0  # 0 => dynamic
+FP16_INITIAL_SCALE_POWER_DEFAULT = 16
+FP16_LOSS_SCALE_WINDOW_DEFAULT = 1000
+FP16_HYSTERESIS_DEFAULT = 2
+FP16_MIN_LOSS_SCALE_DEFAULT = 1.0
+
+ROUTE_TRAIN = "train"
+ROUTE_EVAL = "eval"
+ROUTE_PREDICT = "predict"
+ROUTE_ENCODE = "encode"

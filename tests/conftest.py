@@ -1,0 +1,48 @@
+"""Test harness configuration.
+
+Multi-chip logic is tested on a virtual 8-device CPU mesh
+(``xla_force_host_platform_device_count``), the JAX-native analogue of the
+reference's fork-N-processes ``DistributedTest`` fixture
+(`/root/reference/tests/unit/common.py:69`): instead of one process per GPU
+rank, one process drives 8 logical devices and `shard_map`/`pjit` exercise the
+same collective paths the real pod would run.
+"""
+import os
+
+# Must happen before the first JAX backend use (the TPU/axon plugin may
+# already be *registered* by a sitecustomize, but backends initialize lazily —
+# forcing the platform + host-device flags here still wins).
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu" and len(jax.devices()) == 8, \
+    "test harness requires the 8-device virtual CPU mesh"
+
+jax.config.update("jax_threefry_partitionable", True)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture
+def mesh8():
+    """data=8 mesh."""
+    from deepspeed_tpu.parallel.topology import build_mesh
+    return build_mesh()
+
+
+@pytest.fixture
+def mesh_2d():
+    """data=4 × model=2 mesh."""
+    from deepspeed_tpu.parallel.topology import build_mesh
+    from deepspeed_tpu.runtime.config import MeshConfig
+    return build_mesh(MeshConfig(data=4, model=2))

@@ -1,0 +1,109 @@
+"""Collective wrapper tests (reference analogue: `tests/unit/comm/test_dist.py`)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from deepspeed_tpu import comm
+from deepspeed_tpu.comm import ReduceOp
+from deepspeed_tpu.comm.comms_logging import configure as log_configure
+
+
+def _smap(mesh, fn, in_spec, out_spec):
+    return shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+                     check_vma=False)
+
+
+def test_all_reduce_sum(mesh8):
+    x = jnp.arange(8.0)
+    out = _smap(mesh8, lambda v: comm.all_reduce(v, ReduceOp.SUM, "data"),
+                P("data"), P("data"))(x)
+    np.testing.assert_allclose(out, np.full(8, 28.0))
+
+
+def test_all_reduce_variants(mesh8):
+    x = jnp.arange(1.0, 9.0)
+    for op, expect in [(ReduceOp.MAX, 8.0), (ReduceOp.MIN, 1.0),
+                       (ReduceOp.AVG, 4.5)]:
+        out = _smap(mesh8, lambda v, op=op: comm.all_reduce(v, op, "data"),
+                    P("data"), P("data"))(x)
+        np.testing.assert_allclose(out, np.full(8, expect), rtol=1e-6)
+
+
+def test_all_gather(mesh8):
+    x = jnp.arange(8.0)
+    out = _smap(mesh8, lambda v: comm.all_gather(v, "data"),
+                P("data"), P())(x)
+    np.testing.assert_allclose(out, np.arange(8.0))
+
+
+def test_reduce_scatter_matches_manual(mesh8):
+    x = jnp.arange(64.0).reshape(8, 8)
+    out = _smap(mesh8, lambda v: comm.reduce_scatter(v[0], ReduceOp.SUM, "data"),
+                P("data", None), P("data"))(x)
+    np.testing.assert_allclose(np.asarray(out), x.sum(axis=0))
+
+
+def test_all_to_all(mesh8):
+    x = jnp.arange(64.0).reshape(64,)
+    out = _smap(mesh8, lambda v: comm.all_to_all_single(v, "data"),
+                P("data"), P("data"))(x)
+    expect = np.arange(64.0).reshape(8, 8).T.reshape(64)
+    np.testing.assert_allclose(np.asarray(out), expect)
+
+
+def test_broadcast(mesh8):
+    x = jnp.arange(8.0)
+    out = _smap(mesh8, lambda v: comm.broadcast(v, src=3, axis_name="data"),
+                P("data"), P("data"))(x)
+    np.testing.assert_allclose(out, np.full(8, 3.0))
+
+
+def test_ppermute_ring(mesh8):
+    x = jnp.arange(8.0)
+    out = _smap(mesh8, lambda v: comm.send_recv_next(v, 8, "data"),
+                P("data"), P("data"))(x)
+    np.testing.assert_allclose(np.asarray(out), np.roll(np.arange(8.0), 1))
+
+
+def test_comms_logger_records(mesh8):
+    cl = log_configure(verbose=False)
+    cl.reset()
+    x = jnp.arange(8.0)
+    jax.jit(_smap(mesh8, lambda v: comm.all_reduce(v, ReduceOp.SUM, "data"),
+                  P("data"), P("data")))(x).block_until_ready()
+    assert "all_reduce" in cl.comms_dict
+    summary = comm.log_summary()
+    assert "all_reduce" in summary
+    cl.enabled = False
+
+
+def test_world_size_rank():
+    # process-level contract: rank in [0, world_size)
+    assert comm.get_world_size() == 1
+    assert comm.get_rank() == 0
+    assert comm.get_device_count() == 8
+    comm.barrier()
+
+
+def test_all_reduce_product_with_negatives(mesh8):
+    x = jnp.array([1.0, -2.0, 3.0, 1.0, 1.0, -1.0, 2.0, 1.0])
+    out = _smap(mesh8, lambda v: comm.all_reduce(v, ReduceOp.PRODUCT, "data"),
+                P("data"), P("data"))(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 12.0), rtol=1e-5)
+
+
+def test_prof_ops_filter(mesh8):
+    cl = log_configure(prof_ops=["all_gather"])
+    cl.reset()
+    x = jnp.arange(8.0)
+    _smap(mesh8, lambda v: comm.all_reduce(v, ReduceOp.SUM, "data"),
+          P("data"), P("data"))(x)
+    assert "all_reduce" not in cl.comms_dict
+    _smap(mesh8, lambda v: comm.all_gather(v, "data"), P("data"), P())(x)
+    assert "all_gather" in cl.comms_dict
+    cl.enabled = False
+    cl.prof_all = True
+    cl.prof_ops = []

@@ -1,0 +1,95 @@
+"""Config system tests (reference analogue: `tests/unit/runtime/test_ds_config_dict.py`)."""
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import (DeepSpeedConfig, ZeroConfig,
+                                          OffloadDeviceEnum)
+
+
+def test_basic_dict_config():
+    cfg = DeepSpeedConfig({"train_batch_size": 16}, world_size=4)
+    assert cfg.train_batch_size == 16
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_triple_inference():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 2,
+                           "gradient_accumulation_steps": 3}, world_size=4)
+    assert cfg.train_batch_size == 24
+
+
+def test_batch_triple_indivisible_rejected():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"train_batch_size": 10,
+                         "train_micro_batch_size_per_gpu": 4}, world_size=2)
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"train_batch_size": 10}, world_size=4)
+
+
+def test_batch_triple_conflict():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"train_batch_size": 10,
+                         "train_micro_batch_size_per_gpu": 2,
+                         "gradient_accumulation_steps": 3}, world_size=4)
+
+
+def test_batch_resolution_deferred_until_mesh():
+    cfg = DeepSpeedConfig({"train_batch_size": 32})
+    cfg.resolve_batch_sizes(dp_world=8)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_json_file_roundtrip(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({
+        "train_batch_size": 8,
+        "fp16": {"enabled": True, "initial_scale_power": 8},
+        "zero_optimization": {"stage": 2, "overlap_comm": True},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+    }))
+    cfg = DeepSpeedConfig(str(p), world_size=2)
+    assert cfg.fp16.enabled and cfg.fp16.dynamic
+    assert cfg.fp16.initial_scale_power == 8
+    assert cfg.zero_config.stage == 2
+    assert cfg.optimizer.params["lr"] == 1e-4
+
+
+def test_duplicate_keys_rejected(tmp_path):
+    p = tmp_path / "dup.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(p), world_size=1)
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "fp16": {"enabled": True},
+                         "bf16": {"enabled": True}}, world_size=1)
+
+
+def test_zero_deprecated_cpu_offload():
+    z = ZeroConfig(stage=2, cpu_offload=True)
+    assert z.offload_optimizer.device == OffloadDeviceEnum.cpu
+
+
+def test_zero_offload_param_requires_stage3():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "zero_optimization": {
+                             "stage": 2,
+                             "offload_param": {"device": "cpu"}}},
+                        world_size=1)
+
+
+def test_unknown_zero_key_rejected():
+    with pytest.raises(Exception):
+        ZeroConfig(stage=1, no_such_option=True)
+
+
+def test_mesh_block():
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "mesh": {"data": 2, "model": 4}}, world_size=2)
+    assert cfg.mesh.model == 4

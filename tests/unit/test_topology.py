@@ -1,0 +1,65 @@
+"""Mesh / topology tests (reference analogue: `tests/unit/runtime/pipe/test_topology.py`)."""
+import numpy as np
+import pytest
+
+from deepspeed_tpu.parallel.topology import (
+    ProcessTopology, PipeModelDataParallelTopology, build_mesh,
+    resolve_mesh_spec, batch_sharding, dp_world_size, mp_world_size)
+from deepspeed_tpu.runtime.config import MeshConfig
+
+
+def test_process_topology_rank_coord_roundtrip():
+    topo = ProcessTopology(["pipe", "data"], [2, 4])
+    assert topo.world_size == 8
+    for r in range(8):
+        c = topo.get_coord(r)
+        assert topo.get_rank(**c) == r
+
+
+def test_topology_axis_lists():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_dp=2, num_mp=2)
+    # ranks enumerate row-major over (pipe, data, model)
+    assert topo.get_rank(pipe=0, data=0, model=0) == 0
+    assert topo.get_rank(pipe=1, data=1, model=1) == 7
+    assert topo.get_axis_list("pipe", 0) == [0, 1, 2, 3]
+    lists = topo.get_axis_comm_lists("model")
+    assert [0, 1] in lists and [6, 7] in lists
+    assert topo.filter_match(pipe=1, model=0) == [4, 6]
+
+
+def test_topology_unknown_axis():
+    topo = ProcessTopology(["data"], [4])
+    with pytest.raises(ValueError):
+        topo.get_rank(bogus=0)
+
+
+def test_resolve_mesh_wildcard():
+    spec = resolve_mesh_spec(MeshConfig(model=2), 8)
+    assert spec.data == 4 and spec.model == 2
+    assert spec.world_size == 8
+
+
+def test_resolve_mesh_bad_product():
+    with pytest.raises(ValueError):
+        resolve_mesh_spec(MeshConfig(data=3, model=2), 8)
+
+
+def test_build_mesh_axes(mesh8):
+    assert mesh8.shape["data"] == 8
+    assert dp_world_size(mesh8) == 8
+    assert mp_world_size(mesh8) == 1
+
+
+def test_build_mesh_2d(mesh_2d):
+    assert mesh_2d.shape["data"] == 4
+    assert mesh_2d.shape["model"] == 2
+    spec = batch_sharding(mesh_2d).spec
+    assert spec == type(spec)(("data",))
+
+
+def test_mesh_places_batch():
+    import jax
+    import jax.numpy as jnp
+    mesh = build_mesh(MeshConfig(data=8))
+    x = jax.device_put(jnp.arange(16.0).reshape(16, 1), batch_sharding(mesh))
+    assert len(x.sharding.device_set) == 8
