@@ -1,0 +1,96 @@
+"""deepspeed_tpu — a TPU-native training & inference framework with the
+capability surface of DeepSpeed (reference: /root/reference, v0.8.2),
+built on JAX/XLA/Pallas over named-axis device meshes.
+
+Top-level API mirrors the reference `deepspeed/__init__.py`:
+    initialize()        (`__init__.py:52`)  → engine for training
+    init_inference()    (`__init__.py:233`) → engine for serving
+    init_distributed()  → multi-host bootstrap
+    add_config_arguments() (`__init__.py:210`)
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+__version__ = "0.1.0"
+
+from . import comm  # noqa: F401
+from .accelerator.tpu_accelerator import get_accelerator  # noqa: F401
+from .comm.comm import init_distributed  # noqa: F401
+from .runtime.config import DeepSpeedConfig  # noqa: F401
+from .runtime.engine import DeepSpeedEngine  # noqa: F401
+from .runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader  # noqa: F401
+from .parallel.topology import build_mesh  # noqa: F401
+
+
+def initialize(args: Any = None,
+               model: Any = None,
+               optimizer: Any = None,
+               model_parameters: Any = None,
+               training_data: Any = None,
+               lr_scheduler: Any = None,
+               mesh: Any = None,
+               dist_init_required: Optional[bool] = None,
+               collate_fn: Any = None,
+               config: Any = None,
+               config_params: Any = None,
+               loss_fn: Any = None,
+               param_specs: Any = None,
+               rng: Any = None) -> Tuple:
+    """Build a training engine. Reference: `deepspeed/__init__.py:52`.
+
+    `model` is a functional model (init/apply/loss, optional partition_specs)
+    rather than an nn.Module; `optimizer` may be a deepspeed_tpu Optimizer,
+    an optax GradientTransformation, or None (config-driven). Returns
+    ``(engine, optimizer, dataloader, lr_scheduler)`` exactly like the
+    reference (`__init__.py:150`).
+    """
+    del model_parameters  # params are part of engine state in JAX
+    if config is None:
+        config = config_params
+    if config is None and args is not None:
+        config = getattr(args, "deepspeed_config", None)
+    if model is None:
+        raise ValueError("deepspeed_tpu.initialize requires a model")
+    if dist_init_required:
+        init_distributed()
+
+    engine = DeepSpeedEngine(model=model, config=config, mesh=mesh,
+                             optimizer=optimizer, lr_scheduler=lr_scheduler,
+                             loss_fn=loss_fn, param_specs=param_specs,
+                             rng=rng)
+    dataloader = None
+    if training_data is not None:
+        dataloader = DeepSpeedDataLoader(
+            training_data, batch_size=engine.train_batch_size,
+            collate_fn=collate_fn)
+    return engine, engine.optimizer, dataloader, engine.lr_schedule
+
+
+def init_inference(model: Any = None, config: Any = None, **kwargs):
+    """Build an inference engine. Reference: `deepspeed/__init__.py:233`
+    (merges config dict + kwargs the same way)."""
+    try:
+        from .inference.engine import InferenceEngine
+        from .inference.config import DeepSpeedInferenceConfig
+    except ImportError as e:
+        raise NotImplementedError(
+            "inference engine module not available yet") from e
+    cfg_dict = dict(config) if isinstance(config, dict) else {}
+    cfg_dict.update(kwargs)
+    return InferenceEngine(model, DeepSpeedInferenceConfig(**cfg_dict))
+
+
+def add_config_arguments(parser):
+    """Reference `deepspeed/__init__.py:210` — argparse plumbing."""
+    group = parser.add_argument_group("DeepSpeed-TPU",
+                                      "DeepSpeed-TPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed-TPU (helper flag)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the JSON config file")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help="Deprecated alias of --deepspeed")
+    group.add_argument("--deepscale_config", default=None, type=str,
+                       help="Deprecated alias of --deepspeed_config")
+    return parser

@@ -1,0 +1,2 @@
+from .transformer import (TransformerConfig, TransformerLM,  # noqa: F401
+                          gpt2_config, neox_config)

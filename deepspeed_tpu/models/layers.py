@@ -1,0 +1,180 @@
+"""Functional NN layers (pure init/apply, pytree params).
+
+This is the compute vocabulary of the model zoo. Where the reference fuses
+these into CUDA kernels (`/root/reference/csrc/transformer/` — gelu, layernorm,
+softmax, dropout, transform kernels), we express them as jnp ops and let XLA
+fuse them into the surrounding matmuls; Pallas kernels replace only the ops
+XLA can't schedule well (attention — see `deepspeed_tpu/ops/`).
+
+Params are plain nested dicts so every parallelism layer (ZeRO, TP, PP) can
+operate on them as pytrees with partition-spec trees.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# Initializers
+# ---------------------------------------------------------------------------
+def normal_init(rng, shape, stddev=0.02, dtype=jnp.float32):
+    return (stddev * jax.random.normal(rng, shape)).astype(dtype)
+
+
+def scaled_init(rng, shape, stddev, num_layers, dtype=jnp.float32):
+    """GPT-2 style residual-branch init: stddev / sqrt(2 * num_layers)."""
+    return normal_init(rng, shape, stddev / math.sqrt(2.0 * num_layers), dtype)
+
+
+def zeros_init(_rng, shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+def ones_init(_rng, shape, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# Dense
+# ---------------------------------------------------------------------------
+def dense_init(rng, in_dim: int, out_dim: int, use_bias: bool = True,
+               stddev: float = 0.02, dtype=jnp.float32):
+    p = {"kernel": normal_init(rng, (in_dim, out_dim), stddev, dtype)}
+    if use_bias:
+        p["bias"] = jnp.zeros((out_dim,), dtype)
+    return p
+
+
+def dense_apply(params, x, *, precision=None):
+    y = jnp.einsum("...i,io->...o", x, params["kernel"], precision=precision)
+    if "bias" in params:
+        y = y + params["bias"].astype(y.dtype)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# LayerNorm / RMSNorm — computed in fp32 regardless of activation dtype,
+# matching the reference's normalize_kernels.cu accumulation behavior.
+# ---------------------------------------------------------------------------
+def layernorm_init(_rng, dim: int, dtype=jnp.float32):
+    return {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)}
+
+
+def layernorm_apply(params, x, eps: float = 1e-5):
+    orig_dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    y = y * params["scale"].astype(jnp.float32)
+    if "bias" in params:
+        y = y + params["bias"].astype(jnp.float32)
+    return y.astype(orig_dtype)
+
+
+def rmsnorm_init(_rng, dim: int, dtype=jnp.float32):
+    return {"scale": jnp.ones((dim,), dtype)}
+
+
+def rmsnorm_apply(params, x, eps: float = 1e-6):
+    orig_dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (y * params["scale"].astype(jnp.float32)).astype(orig_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+def gelu(x):
+    # tanh approximation — same variant as the reference's gelu_kernels.cu.
+    return jax.nn.gelu(x, approximate=True)
+
+
+ACT_FNS = {
+    "gelu": gelu,
+    "gelu_exact": lambda x: jax.nn.gelu(x, approximate=False),
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+}
+
+
+# ---------------------------------------------------------------------------
+# Rotary position embeddings (GPT-NeoX style)
+# ---------------------------------------------------------------------------
+def rotary_freqs(head_dim: int, rotary_dim: int, max_seq: int,
+                 base: float = 10000.0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    inv = 1.0 / (base ** (jnp.arange(0, rotary_dim, 2, dtype=jnp.float32)
+                          / rotary_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)                      # [T, rotary_dim/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rotary(x, cos, sin, positions=None):
+    """x: [B, T, H, Dh]; rotate first rotary_dim dims (interleaved-pair
+    convention, reference `csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu`).
+    """
+    rotary_dim = cos.shape[-1] * 2
+    x_rot, x_pass = x[..., :rotary_dim], x[..., rotary_dim:]
+    if positions is None:
+        c = cos[None, :x.shape[1], None, :]
+        s = sin[None, :x.shape[1], None, :]
+    else:
+        c = cos[positions][:, :, None, :]
+        s = sin[positions][:, :, None, :]
+    x1, x2 = x_rot[..., 0::2], x_rot[..., 1::2]
+    y1 = x1 * c - x2 * s
+    y2 = x2 * c + x1 * s
+    y = jnp.stack([y1, y2], axis=-1).reshape(x_rot.shape)
+    return jnp.concatenate([y.astype(x.dtype), x_pass], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Attention core (XLA path; Pallas flash kernel replaces this on TPU hot path)
+# ---------------------------------------------------------------------------
+def causal_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
+                     scale: Optional[float] = None,
+                     kv_positions_offset: int = 0):
+    """q,k,v: [B, Tq, H, Dh] / [B, Tk, H, Dh]. Softmax in fp32 (the reference's
+    softmax_kernels.cu accumulates fp32 too). Returns [B, Tq, H, Dh]."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    # bf16 operands, fp32 accumulation — MXU-native mixed precision.
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    tq, tk = q.shape[1], k.shape[1]
+    q_pos = jnp.arange(tq) + kv_positions_offset
+    k_pos = jnp.arange(tk)
+    causal = q_pos[:, None] >= k_pos[None, :]
+    logits = jnp.where(causal[None, None], logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# Embedding
+# ---------------------------------------------------------------------------
+def embedding_init(rng, vocab: int, dim: int, stddev=0.02, dtype=jnp.float32):
+    return {"embedding": normal_init(rng, (vocab, dim), stddev, dtype)}
+
+
+def embedding_apply(params, ids, dtype=None):
+    emb = params["embedding"]
+    if dtype is not None:
+        emb = emb.astype(dtype)
+    return jnp.take(emb, ids, axis=0)
+
+
+def embedding_attend(params, x):
+    """Tied-softmax projection: x @ embedding.T — bf16 operands, fp32
+    accumulation (logits come out fp32 without a fp32 matmul)."""
+    return jnp.einsum("...d,vd->...v", x,
+                      params["embedding"].astype(x.dtype),
+                      preferred_element_type=jnp.float32)

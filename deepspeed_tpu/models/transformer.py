@@ -1,0 +1,422 @@
+"""Decoder-only transformer LM family (GPT-2 / GPT-NeoX style).
+
+The flagship model family of the framework — the role the reference's fused
+transformer layer + model zoo plays (`/root/reference/csrc/transformer/`,
+`/root/reference/deepspeed/model_implementations/transformers/`), designed
+TPU-first:
+
+  - **scan over stacked layer params**: all blocks share one set of weights
+    stacked on a leading ``L`` axis and run under `lax.scan`. One compiled
+    block instead of L inlined copies (fast compiles), a natural remat
+    boundary, and the unit at which ZeRO-3 gathers/releases params.
+  - **remat policy** per config (`jax.checkpoint`) replaces the reference's
+    activation-checkpointing reimplementation
+    (`runtime/activation_checkpointing/checkpointing.py:498`).
+  - **partition rules** produce a params-shaped PartitionSpec tree (TP over
+    the ``model`` axis; ZeRO transforms these further over ``data``).
+  - fp32 softmax/layernorm islands inside a bf16 activation stream — the same
+    numeric contract as the reference's CUDA kernels.
+
+Variants: ``gpt2`` (learned positions, serial residual), ``neox`` (rotary,
+parallel residual — GPT-NeoX-20B architecture, the BASELINE.json 1.3B/20B
+target family).
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import layers as L
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50304
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 0                      # 0 → 4 * d_model
+    head_dim: int = 0                  # 0 → d_model // num_heads
+    pos_embedding: str = "learned"     # learned | rotary | none
+    rotary_pct: float = 1.0
+    rotary_base: float = 10000.0
+    parallel_residual: bool = False    # NeoX-style x + attn(ln1 x) + mlp(ln2 x)
+    norm_type: str = "layernorm"       # layernorm | rmsnorm
+    activation: str = "gelu"
+    use_bias: bool = True
+    tie_embeddings: bool = True
+    dtype: Any = jnp.bfloat16          # activation dtype
+    param_dtype: Any = jnp.float32
+    remat: str = "none"                # none | full | dots_saveable | nothing_saveable
+    attn_impl: str = "xla"             # xla | flash (pallas)
+    layernorm_eps: float = 1e-5
+    # Chunked cross-entropy: the [B,T,V] logits tensor is the largest HBM
+    # object at vocab 50k; computing the loss in sequence chunks of this many
+    # tokens (0 = off) keeps only [B,chunk,V] live, rematerializing per chunk
+    # in backward.
+    loss_chunk: int = 512
+
+    @property
+    def ff_dim(self) -> int:
+        return self.d_ff or 4 * self.d_model
+
+    @property
+    def hdim(self) -> int:
+        return self.head_dim or self.d_model // self.num_heads
+
+    @property
+    def rotary_dim(self) -> int:
+        d = int(self.hdim * self.rotary_pct)
+        return d - d % 2
+
+    def num_params(self) -> int:
+        d, f, v = self.d_model, self.ff_dim, self.vocab_size
+        nhd = self.num_heads * self.hdim
+        norm = 2 * d if self.norm_type == "layernorm" else d
+        per_layer = d * 3 * nhd + nhd * d + 2 * d * f + 2 * norm
+        if self.use_bias:
+            per_layer += 3 * nhd + d + f + d
+        emb = v * d + (self.max_seq_len * d if self.pos_embedding == "learned" else 0)
+        head = 0 if self.tie_embeddings else d * v
+        return self.num_layers * per_layer + emb + head + norm
+
+
+GPT2_SIZES = {
+    "125m": dict(num_layers=12, num_heads=12, d_model=768),
+    "350m": dict(num_layers=24, num_heads=16, d_model=1024),
+    "760m": dict(num_layers=24, num_heads=16, d_model=1536),
+    "1.3b": dict(num_layers=24, num_heads=32, d_model=2048),
+    "2.7b": dict(num_layers=32, num_heads=32, d_model=2560),
+    "6.7b": dict(num_layers=32, num_heads=32, d_model=4096),
+    "13b": dict(num_layers=40, num_heads=40, d_model=5120),
+}
+NEOX_SIZES = {
+    "1.3b": dict(num_layers=24, num_heads=16, d_model=2048),
+    "20b": dict(num_layers=44, num_heads=64, d_model=6144, rotary_pct=0.25),
+}
+
+
+def gpt2_config(size: str = "125m", **kw) -> TransformerConfig:
+    return TransformerConfig(**{"pos_embedding": "learned",
+                                "parallel_residual": False,
+                                **GPT2_SIZES[size], **kw})
+
+
+def neox_config(size: str = "1.3b", **kw) -> TransformerConfig:
+    return TransformerConfig(**{"pos_embedding": "rotary",
+                                "parallel_residual": True,
+                                **NEOX_SIZES[size], **kw})
+
+
+class TransformerLM:
+    """Pure-functional LM: ``init`` → params pytree, ``apply`` → logits.
+
+    ``constrain`` is an optional activation-sharding hook (x -> x) applied at
+    block boundaries; the engine passes a `with_sharding_constraint` closure so
+    the model stays mesh-agnostic.
+    """
+
+    def __init__(self, config: TransformerConfig,
+                 constrain: Optional[Callable] = None):
+        self.config = config
+        self.constrain = constrain or (lambda x: x)
+        if config.pos_embedding == "rotary":
+            self._cos, self._sin = L.rotary_freqs(
+                config.hdim, config.rotary_dim, config.max_seq_len,
+                config.rotary_base)
+
+    # -- init --------------------------------------------------------------
+    def init(self, rng) -> Dict:
+        c = self.config
+        dt = c.param_dtype
+        keys = jax.random.split(rng, 8)
+        d, f, nh, hd = c.d_model, c.ff_dim, c.num_heads, c.hdim
+        norm_init = (L.layernorm_init if c.norm_type == "layernorm"
+                     else L.rmsnorm_init)
+
+        def stack(init_fn, key, n=c.num_layers):
+            ks = jax.random.split(key, n)
+            return jax.vmap(init_fn)(ks)
+
+        def block_init(k):
+            k1, k2, k3, k4 = jax.random.split(k, 4)
+            blk = {
+                "ln1": norm_init(None, d, dt),
+                "attn": {
+                    "qkv": L.dense_init(k1, d, 3 * nh * hd, c.use_bias, 0.02, dt),
+                    "out": {"kernel": L.scaled_init(k2, (nh * hd, d), 0.02,
+                                                    c.num_layers, dt)},
+                },
+                "ln2": norm_init(None, d, dt),
+                "mlp": {
+                    "fc_in": L.dense_init(k3, d, f, c.use_bias, 0.02, dt),
+                    "fc_out": {"kernel": L.scaled_init(k4, (f, d), 0.02,
+                                                       c.num_layers, dt)},
+                },
+            }
+            if c.use_bias:
+                blk["attn"]["out"]["bias"] = jnp.zeros((d,), dt)
+                blk["mlp"]["fc_out"]["bias"] = jnp.zeros((d,), dt)
+            return blk
+
+        params = {
+            "embed": L.embedding_init(keys[0], c.vocab_size, d, 0.02, dt),
+            "blocks": stack(block_init, keys[1]),
+            "ln_f": norm_init(None, d, dt),
+        }
+        if c.pos_embedding == "learned":
+            params["pos_embed"] = L.embedding_init(keys[2], c.max_seq_len, d,
+                                                   0.01, dt)
+        if not c.tie_embeddings:
+            params["lm_head"] = {"kernel": L.normal_init(keys[3], (d, c.vocab_size),
+                                                         0.02, dt)}
+        return params
+
+    # -- block -------------------------------------------------------------
+    def _attention(self, p, x, cache_kv=None, positions=None):
+        c = self.config
+        nh, hd = c.num_heads, c.hdim
+        qkv = L.dense_apply(p["qkv"], x)
+        b, t = qkv.shape[0], qkv.shape[1]
+        qkv = qkv.reshape(b, t, 3, nh, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if c.pos_embedding == "rotary":
+            cos = self._cos.astype(jnp.float32)
+            sin = self._sin.astype(jnp.float32)
+            q = L.apply_rotary(q, cos, sin, positions)
+            k = L.apply_rotary(k, cos, sin, positions)
+        new_cache = None
+        offset = 0
+        if cache_kv is None and c.attn_impl == "flash":
+            from ..ops.transformer.flash_attention import (
+                flash_attention_bthd, supports)
+            if supports(q.shape[1], k.shape[1]):
+                o = flash_attention_bthd(q, k, v)
+                o = o.reshape(b, t, nh * hd)
+                return L.dense_apply(p["out"], o), None
+        if cache_kv is not None:
+            ck, cv, idx = cache_kv
+            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                              (0, idx, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                              (0, idx, 0, 0))
+            k, v = ck, cv
+            offset = idx
+            new_cache = (ck, cv)
+            tk = ck.shape[1]
+            valid = jnp.arange(tk)[None, None, None, :] < (idx + t)
+            o = L.causal_attention(q, k.astype(q.dtype), v.astype(q.dtype),
+                                   mask=valid, kv_positions_offset=offset)
+        else:
+            o = L.causal_attention(q, k, v)
+        o = o.reshape(b, t, nh * hd)
+        return L.dense_apply(p["out"], o), new_cache
+
+    def _mlp(self, p, x):
+        h = L.dense_apply(p["fc_in"], x)
+        h = L.ACT_FNS[self.config.activation](h)
+        return L.dense_apply(p["fc_out"], h)
+
+    def _block(self, bp, x, cache_kv=None, positions=None):
+        c = self.config
+        norm = (L.layernorm_apply if c.norm_type == "layernorm"
+                else L.rmsnorm_apply)
+        norm = partial(norm, eps=c.layernorm_eps)
+        x = self.constrain(x)
+        if c.parallel_residual:
+            a, new_cache = self._attention(bp["attn"], norm(bp["ln1"], x),
+                                           cache_kv, positions)
+            m = self._mlp(bp["mlp"], norm(bp["ln2"], x))
+            x = x + a + m
+        else:
+            a, new_cache = self._attention(bp["attn"], norm(bp["ln1"], x),
+                                           cache_kv, positions)
+            x = x + a
+            x = x + self._mlp(bp["mlp"], norm(bp["ln2"], x))
+        return self.constrain(x), new_cache
+
+    def _remat_block(self):
+        """Wrap the block with the configured rematerialization policy —
+        replaces the reference's activation-checkpointing subsystem
+        (`runtime/activation_checkpointing/checkpointing.py:498`).
+        ``dots_no_batch`` is the transformer sweet spot: dense matmul outputs
+        are saved, the O(T²) attention scores are recomputed in backward."""
+        c = self.config
+        if c.remat == "none":
+            return self._block
+        policy = {
+            "full": None,
+            "dots_saveable": jax.checkpoint_policies.dots_saveable,
+            "dots_no_batch":
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+        }[c.remat]
+        return jax.checkpoint(self._block, policy=policy)
+
+    # -- full forward ------------------------------------------------------
+    def apply(self, params, input_ids, cache=None, positions=None):
+        """input_ids [B, T] → logits [B, T, V] (fp32).
+
+        ``cache`` — KV cache dict from `init_cache` for incremental decoding;
+        returns (logits, updated_cache) when provided.
+        """
+        c = self.config
+        if cache is None:
+            return self._project(params, self.hidden_states(params, input_ids))
+
+        idx = cache["index"]
+        if positions is None:
+            # incremental decode default: continue from the cache index
+            positions = idx + jnp.arange(input_ids.shape[1])[None, :]
+        x = L.embedding_apply(params["embed"], input_ids, c.dtype)
+        if c.pos_embedding == "learned":
+            x = x + L.embedding_apply(params["pos_embed"], positions, c.dtype)
+
+        def scan_fn(carry, xs):
+            bp, ck, cv = xs
+            y, kv = self._block(bp, carry, (ck, cv, idx), positions)
+            return y, kv
+        x, (nk, nv) = jax.lax.scan(scan_fn, x,
+                                   (params["blocks"], cache["k"], cache["v"]))
+        new_cache = {"k": nk, "v": nv, "index": idx + input_ids.shape[1]}
+        norm = (L.layernorm_apply if c.norm_type == "layernorm"
+                else L.rmsnorm_apply)
+        x = norm(params["ln_f"], x, eps=c.layernorm_eps)
+        return self._project(params, x), new_cache
+
+    def _project(self, params, x):
+        if self.config.tie_embeddings:
+            return L.embedding_attend(params["embed"], x)
+        return jnp.einsum("...d,dv->...v", x,
+                          params["lm_head"]["kernel"].astype(x.dtype),
+                          preferred_element_type=jnp.float32)
+
+    def hidden_states(self, params, input_ids):
+        """Forward up to the final norm, pre-projection ([B,T,D])."""
+        c = self.config
+        x = L.embedding_apply(params["embed"], input_ids, c.dtype)
+        if c.pos_embedding == "learned":
+            pos = jnp.arange(input_ids.shape[1])[None, :]
+            x = x + L.embedding_apply(params["pos_embed"], pos, c.dtype)
+        block = self._remat_block()
+
+        def scan_fn(carry, bp):
+            y, _ = block(bp, carry)
+            return y, None
+        x, _ = jax.lax.scan(scan_fn, x, params["blocks"])
+        norm = (L.layernorm_apply if c.norm_type == "layernorm"
+                else L.rmsnorm_apply)
+        return norm(params["ln_f"], x, eps=c.layernorm_eps)
+
+    def init_cache(self, batch: int, max_len: int, dtype=None) -> Dict:
+        c = self.config
+        dtype = dtype or c.dtype
+        shape = (c.num_layers, batch, max_len, c.num_heads, c.hdim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+                "index": jnp.array(0, jnp.int32)}
+
+    # -- loss --------------------------------------------------------------
+    def loss(self, params, batch) -> jnp.ndarray:
+        """Causal LM loss. batch: {'input_ids' [B,T]} (labels = shifted) or
+        explicit {'input_ids', 'labels', optional 'loss_mask'}."""
+        ids = batch["input_ids"]
+        mask = batch.get("loss_mask")
+        if "labels" in batch:
+            labels, logits_in = batch["labels"], ids
+        else:
+            # Shift labels, keep the full T through the model (power-of-two
+            # seq lengths keep the flash kernel's block divisibility); the
+            # final position is masked out instead of sliced off.
+            labels = jnp.concatenate(
+                [ids[:, 1:], jnp.zeros_like(ids[:, :1])], axis=1)
+            logits_in = ids
+            last_mask = jnp.ones_like(ids, dtype=jnp.float32).at[:, -1].set(0.0)
+            mask = last_mask if mask is None else mask * last_mask
+
+        chunk = self.config.loss_chunk
+        t = labels.shape[1]
+        if chunk and t > chunk and t % chunk == 0:
+            # Chunked CE: never materialize [B,T,V]; per chunk the projection
+            # + logsumexp recompute in backward (jax.checkpoint).
+            x = self.hidden_states(params, logits_in)  # [B,T,D]
+            n_chunks = t // chunk
+
+            def to_chunks(a):
+                return a.reshape(a.shape[0], n_chunks, chunk,
+                                 *a.shape[2:]).swapaxes(0, 1)
+
+            @jax.checkpoint
+            def chunk_nll(xc, yc, mc):
+                logits = self._project(params, xc)
+                lse = jax.scipy.special.logsumexp(logits, axis=-1)
+                tgt = jnp.take_along_axis(logits, yc[..., None],
+                                          axis=-1)[..., 0]
+                nll = lse - tgt
+                return jnp.sum(nll * mc), jnp.sum(mc)
+
+            mc_all = (to_chunks(mask.astype(jnp.float32)) if mask is not None
+                      else jnp.ones((n_chunks, labels.shape[0], chunk),
+                                    jnp.float32))
+
+            def body(carry, xs):
+                tot, cnt = carry
+                s, n = chunk_nll(*xs)
+                return (tot + s, cnt + n), None
+            (tot, cnt), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+                (to_chunks(x), to_chunks(labels), mc_all))
+            return tot / jnp.maximum(cnt, 1.0)
+
+        logits = self.apply(params, logits_in)
+        # logsumexp form avoids materializing the full [B,T,V] log-prob array
+        # (matters at vocab 50k: that array is the single biggest HBM tensor).
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+        nll = lse - tgt
+        if mask is None:
+            return jnp.mean(nll)
+        mask = mask.astype(nll.dtype)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    # -- partitioning ------------------------------------------------------
+    def partition_specs(self, params=None) -> Dict:
+        """Params-shaped PartitionSpec tree: tensor-parallel layout over the
+        ``model`` mesh axis (Megatron-style column/row split — role of the
+        reference's `module_inject/replace_module.py:23` ReplaceWithTensorSlicing,
+        decided here declaratively). Leading axis of ``blocks`` leaves is the
+        scan/layer axis (never sharded)."""
+        rules = {
+            ("embed", "embedding"): P("model", None),
+            ("pos_embed", "embedding"): P(None, None),
+            ("blocks", "ln1", "scale"): P(None, None),
+            ("blocks", "ln1", "bias"): P(None, None),
+            ("blocks", "ln2", "scale"): P(None, None),
+            ("blocks", "ln2", "bias"): P(None, None),
+            ("blocks", "attn", "qkv", "kernel"): P(None, None, "model"),
+            ("blocks", "attn", "qkv", "bias"): P(None, "model"),
+            ("blocks", "attn", "out", "kernel"): P(None, "model", None),
+            ("blocks", "attn", "out", "bias"): P(None, None),
+            ("blocks", "mlp", "fc_in", "kernel"): P(None, None, "model"),
+            ("blocks", "mlp", "fc_in", "bias"): P(None, "model"),
+            ("blocks", "mlp", "fc_out", "kernel"): P(None, "model", None),
+            ("blocks", "mlp", "fc_out", "bias"): P(None, None),
+            ("ln_f", "scale"): P(None,),
+            ("ln_f", "bias"): P(None,),
+            ("lm_head", "kernel"): P(None, "model"),
+        }
+        if params is None:
+            params = jax.eval_shape(lambda: self.init(jax.random.PRNGKey(0)))
+
+        def spec_for(path):
+            key = tuple(p.key for p in path)
+            if key in rules:
+                return rules[key]
+            raise KeyError(f"No partition rule for param {key}")
+        return jax.tree_util.tree_map_with_path(
+            lambda path, _: spec_for(path), params)

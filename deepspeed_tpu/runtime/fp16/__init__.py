@@ -1,0 +1,2 @@
+from .loss_scaler import (LossScaleState, DynamicLossScaler,  # noqa: F401
+                          static_loss_scaler)

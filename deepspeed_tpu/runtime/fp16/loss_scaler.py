@@ -1,0 +1,73 @@
+"""Dynamic loss scaling for fp16 training.
+
+Same semantics as the reference ``DynamicLossScaler``
+(`/root/reference/deepspeed/runtime/fp16/loss_scaler.py:77`): scale doubles
+after ``scale_window`` consecutive overflow-free steps, halves on overflow
+(with ``delayed_shift`` hysteresis), never below ``min_scale``. Reformulated
+as a pure state-transition so it lives inside the jitted train step: the
+overflow check is a global `isfinite` reduction over the grad tree (the
+reference's ``CheckOverflow``, `runtime/utils.py:170`) and the skip-update
+becomes a `jnp.where` select rather than a Python branch.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray        # f32 scalar
+    good_steps: jnp.ndarray   # i32 consecutive non-overflow steps
+    hysteresis: jnp.ndarray   # i32 remaining tolerated overflows before halving
+
+
+class DynamicLossScaler:
+    def __init__(self, initial_scale_power: int = 16, scale_window: int = 1000,
+                 min_scale: float = 1.0, hysteresis: int = 2,
+                 scale_factor: float = 2.0):
+        self.initial_scale = 2.0 ** initial_scale_power
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.hysteresis = hysteresis
+        self.scale_factor = scale_factor
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(
+            scale=jnp.asarray(self.initial_scale, jnp.float32),
+            good_steps=jnp.zeros((), jnp.int32),
+            hysteresis=jnp.asarray(self.hysteresis, jnp.int32))
+
+    @staticmethod
+    def has_overflow(grads) -> jnp.ndarray:
+        leaves = jax.tree_util.tree_leaves(grads)
+        finite = jnp.asarray(True)
+        for g in leaves:
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+        return jnp.logical_not(finite)
+
+    def update(self, state: LossScaleState,
+               overflow: jnp.ndarray) -> LossScaleState:
+        hys = jnp.where(overflow, jnp.maximum(state.hysteresis - 1, 0),
+                        state.hysteresis)
+        shrink = overflow & (state.hysteresis <= 1)
+        new_scale = jnp.where(
+            shrink,
+            jnp.maximum(state.scale / self.scale_factor, self.min_scale),
+            state.scale)
+        good = jnp.where(overflow, 0, state.good_steps + 1)
+        grow = (~overflow) & (good >= self.scale_window)
+        new_scale = jnp.where(grow, new_scale * self.scale_factor, new_scale)
+        good = jnp.where(grow, 0, good)
+        hys = jnp.where(grow | shrink, self.hysteresis, hys)
+        return LossScaleState(scale=new_scale, good_steps=good, hysteresis=hys)
+
+
+def static_loss_scaler(scale: float) -> DynamicLossScaler:
+    """Fixed-scale degenerate case (reference ``LossScaler``,
+    `loss_scaler.py:53`)."""
+    s = DynamicLossScaler(initial_scale_power=0, scale_window=1 << 30,
+                          min_scale=scale, hysteresis=1, scale_factor=1.0)
+    s.initial_scale = scale
+    return s

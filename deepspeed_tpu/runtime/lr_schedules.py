@@ -1,0 +1,137 @@
+"""LR schedules.
+
+Same schedule vocabulary as the reference (`/root/reference/deepspeed/runtime/
+lr_schedules.py:17-21`: LRRangeTest, OneCycle, WarmupLR, WarmupDecayLR) plus
+WarmupCosineLR. Schedules here are pure functions ``step -> lr`` built from
+config, so they trace cleanly into the jitted train step (the reference calls
+``lr_scheduler.step()`` eagerly each step instead).
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict
+
+import jax.numpy as jnp
+
+LRSchedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def constant_lr(lr: float) -> LRSchedule:
+    return lambda step: jnp.asarray(lr, jnp.float32)
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000,
+              warmup_type: str = "log") -> LRSchedule:
+    """Reference ``WarmupLR`` (`lr_schedules.py:689`): warm up then hold."""
+    wmin, wmax, wsteps = float(warmup_min_lr), float(warmup_max_lr), max(
+        1, int(warmup_num_steps))
+
+    def sched(step):
+        s = jnp.minimum(step.astype(jnp.float32), wsteps)
+        if warmup_type == "log":
+            # log-warmup: lr grows with log(step)/log(warmup_steps)
+            frac = jnp.log1p(s) / math.log(wsteps + 1)
+        else:
+            frac = s / wsteps
+        return wmin + (wmax - wmin) * jnp.clip(frac, 0.0, 1.0)
+
+    return sched
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001,
+                    warmup_num_steps: int = 1000,
+                    warmup_type: str = "log") -> LRSchedule:
+    """Reference ``WarmupDecayLR`` (`lr_schedules.py:743`): warmup then linear
+    decay to 0 at total_num_steps."""
+    warm = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps,
+                     warmup_type)
+    total = float(total_num_steps)
+    wsteps = float(max(1, warmup_num_steps))
+
+    def sched(step):
+        s = step.astype(jnp.float32)
+        decay = jnp.clip((total - s) / jnp.maximum(total - wsteps, 1.0), 0.0, 1.0)
+        return jnp.where(s < wsteps, warm(step), warmup_max_lr * decay)
+
+    return sched
+
+
+def warmup_cosine_lr(total_num_steps: int, warmup_min_ratio: float = 0.0,
+                     warmup_num_steps: int = 1000, cos_min_ratio: float = 0.0,
+                     warmup_max_lr: float = 0.001) -> LRSchedule:
+    total = float(total_num_steps)
+    wsteps = float(max(1, warmup_num_steps))
+
+    def sched(step):
+        s = step.astype(jnp.float32)
+        warm_frac = warmup_min_ratio + (1 - warmup_min_ratio) * jnp.clip(
+            s / wsteps, 0.0, 1.0)
+        prog = jnp.clip((s - wsteps) / jnp.maximum(total - wsteps, 1.0), 0.0, 1.0)
+        cos = cos_min_ratio + (1 - cos_min_ratio) * 0.5 * (
+            1 + jnp.cos(math.pi * prog))
+        return warmup_max_lr * jnp.where(s < wsteps, warm_frac, cos)
+
+    return sched
+
+
+def one_cycle(cycle_min_lr: float, cycle_max_lr: float,
+              cycle_first_step_size: int = 2000,
+              cycle_second_step_size: int = None,
+              decay_step_size: int = 0,
+              decay_lr_rate: float = 0.0) -> LRSchedule:
+    """Reference ``OneCycle`` (`lr_schedules.py:441`): triangular cycle then
+    optional decay phase."""
+    up = float(cycle_first_step_size)
+    down = float(cycle_second_step_size
+                 if cycle_second_step_size is not None else up)
+
+    def sched(step):
+        s = step.astype(jnp.float32)
+        in_up = s < up
+        in_down = (s >= up) & (s < up + down)
+        frac_up = jnp.clip(s / up, 0.0, 1.0)
+        frac_down = jnp.clip((s - up) / down, 0.0, 1.0)
+        lr_cycle = jnp.where(
+            in_up, cycle_min_lr + (cycle_max_lr - cycle_min_lr) * frac_up,
+            cycle_max_lr - (cycle_max_lr - cycle_min_lr) * frac_down)
+        if decay_step_size > 0:
+            decay_steps = jnp.maximum(s - (up + down), 0.0) / decay_step_size
+            lr_decayed = cycle_min_lr / (1.0 + decay_steps * decay_lr_rate)
+            return jnp.where(in_up | in_down, lr_cycle, lr_decayed)
+        return jnp.where(in_up | in_down, lr_cycle, cycle_min_lr)
+
+    return sched
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3,
+                  lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False) -> LRSchedule:
+    """Reference ``LRRangeTest`` (`lr_schedules.py:335`): linearly/staircase
+    increasing LR probe for finding stable LR ranges."""
+    def sched(step):
+        s = step.astype(jnp.float32) / lr_range_test_step_size
+        if lr_range_test_staircase:
+            s = jnp.floor(s)
+        return lr_range_test_min_lr * (1.0 + s * lr_range_test_step_rate)
+
+    return sched
+
+
+REGISTRY: Dict[str, Callable[..., LRSchedule]] = {
+    "WarmupLR": warmup_lr,
+    "WarmupDecayLR": warmup_decay_lr,
+    "WarmupCosineLR": warmup_cosine_lr,
+    "OneCycle": one_cycle,
+    "LRRangeTest": lr_range_test,
+    "Constant": lambda lr=1e-3: constant_lr(lr),
+}
+
+
+def get_lr_schedule(type_name: str, params: dict) -> LRSchedule:
+    if type_name not in REGISTRY:
+        raise ValueError(
+            f"Unknown scheduler {type_name}; have {sorted(REGISTRY)}")
+    return REGISTRY[type_name](**params)

@@ -1,0 +1,1 @@
+from .sharding import ZeroShardingPolicy, shard_over_axis, constrain, to_named  # noqa: F401

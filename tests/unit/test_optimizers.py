@@ -1,0 +1,184 @@
+"""Optimizer numerics vs reference math (torch.optim semantics — the
+reference validates FusedAdam against torch.optim.AdamW in
+`/root/reference/tests/unit/ops/adam/test_cpu_adam.py`; we validate against
+optax, whose adamw matches torch's update rule)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deepspeed_tpu.runtime.optimizers import (adam, adagrad, get_optimizer,
+                                              lamb, sgd, wrap_optax)
+
+
+def make_tree(seed=0):
+    k = jax.random.PRNGKey(seed)
+    k1, k2, k3 = jax.random.split(k, 3)
+    return {"w": jax.random.normal(k1, (8, 4)),
+            "b": jax.random.normal(k2, (4,)),
+            "nested": {"x": jax.random.normal(k3, (3, 3))}}
+
+
+class TestAdamW:
+    def test_matches_optax_adamw(self):
+        params = make_tree(0)
+        grads = make_tree(1)
+        lr, wd = 1e-2, 0.05
+        ours = adam(lr, (0.9, 0.999), 1e-8, wd)
+        state = ours.init(params)
+        tx = optax.adamw(lr, 0.9, 0.999, 1e-8, weight_decay=wd)
+        opt_state = tx.init(params)
+        p_ref = params
+        p_ours = params
+        for _ in range(5):
+            p_ours, state = ours.apply(grads, state, p_ours, lr)
+            updates, opt_state = tx.update(grads, opt_state, p_ref)
+            p_ref = optax.apply_updates(p_ref, updates)
+        for a, b in zip(jax.tree_util.tree_leaves(p_ours),
+                        jax.tree_util.tree_leaves(p_ref)):
+            np.testing.assert_allclose(a, b, atol=1e-5)
+
+    def test_bf16_params_fp32_state(self):
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), make_tree(0))
+        opt = adam(1e-3)
+        state = opt.init(params)
+        assert all(l.dtype == jnp.float32
+                   for l in jax.tree_util.tree_leaves(state["m"]))
+        new_p, _ = opt.apply(params, state, params, 1e-3)
+        assert all(l.dtype == jnp.bfloat16
+                   for l in jax.tree_util.tree_leaves(new_p))
+
+
+class TestLamb:
+    def test_trust_ratio_bounds(self):
+        params = {"w": jnp.ones((4, 4))}
+        grads = {"w": jnp.full((4, 4), 1e-12)}  # tiny grads -> ratio clipped
+        opt = lamb(1e-1, max_coeff=10.0, min_coeff=0.01)
+        state = opt.init(params)
+        new_p, _ = opt.apply(grads, state, params, 1e-1)
+        delta = np.abs(np.asarray(new_p["w"] - params["w"])).max()
+        assert delta > 0
+        assert np.all(np.isfinite(np.asarray(new_p["w"])))
+
+    def test_descends(self):
+        params = {"w": jnp.array([2.0, -3.0])}
+        opt = lamb(1e-1)
+        state = opt.init(params)
+        for _ in range(50):
+            grads = {"w": 2 * params["w"]}  # d/dw of w^2
+            params, state = opt.apply(grads, state, params, 1e-1)
+        assert np.linalg.norm(np.asarray(params["w"])) < 1.0
+
+
+class TestOthers:
+    def test_sgd_momentum_matches_optax(self):
+        params = make_tree(0)
+        grads = make_tree(1)
+        ours = sgd(1e-2, momentum=0.9)
+        state = ours.init(params)
+        tx = optax.sgd(1e-2, momentum=0.9)
+        os_ = tx.init(params)
+        p_ref, p_ours = params, params
+        for _ in range(3):
+            p_ours, state = ours.apply(grads, state, p_ours, 1e-2)
+            up, os_ = tx.update(grads, os_, p_ref)
+            p_ref = optax.apply_updates(p_ref, up)
+        for a, b in zip(jax.tree_util.tree_leaves(p_ours),
+                        jax.tree_util.tree_leaves(p_ref)):
+            np.testing.assert_allclose(a, b, atol=1e-6)
+
+    def test_adagrad_accumulates(self):
+        params = {"w": jnp.array([1.0])}
+        opt = adagrad(1.0)
+        state = opt.init(params)
+        g = {"w": jnp.array([1.0])}
+        p1, state = opt.apply(g, state, params, 1.0)
+        p2, state = opt.apply(g, state, p1, 1.0)
+        step1 = float((params["w"] - p1["w"])[0])
+        step2 = float((p1["w"] - p2["w"])[0])
+        assert step2 < step1  # accumulated sq norm shrinks steps
+
+    def test_registry_names(self):
+        for name in ["Adam", "AdamW", "FusedAdam", "Lamb", "SGD", "Adagrad",
+                     "DeepSpeedCPUAdam"]:
+            opt = get_optimizer(name, lr=1e-3)
+            params = {"w": jnp.ones((2,))}
+            state = opt.init(params)
+            new_p, _ = opt.apply({"w": jnp.ones((2,))}, state, params, 1e-3)
+            assert np.all(np.isfinite(np.asarray(new_p["w"])))
+
+    def test_wrap_optax(self):
+        params = make_tree(0)
+        opt = wrap_optax(optax.adam(1e-2))
+        state = opt.init(params)
+        new_p, state = opt.apply(make_tree(1), state, params, None)
+        assert int(state["step"]) == 1
+        assert not np.allclose(np.asarray(new_p["w"]),
+                               np.asarray(params["w"]))
+
+
+class TestLRSchedules:
+    def test_warmup_lr(self):
+        from deepspeed_tpu.runtime.lr_schedules import warmup_lr
+        s = warmup_lr(0.0, 1e-3, 100, warmup_type="linear")
+        assert float(s(jnp.array(0))) == 0.0
+        assert abs(float(s(jnp.array(50))) - 5e-4) < 1e-9
+        assert abs(float(s(jnp.array(100))) - 1e-3) < 1e-9
+        assert abs(float(s(jnp.array(1000))) - 1e-3) < 1e-9
+
+    def test_warmup_decay(self):
+        from deepspeed_tpu.runtime.lr_schedules import warmup_decay_lr
+        s = warmup_decay_lr(1000, 0.0, 1e-3, 100)
+        assert abs(float(s(jnp.array(100))) - 1e-3) < 1e-6
+        assert float(s(jnp.array(550))) == pytest.approx(5e-4, rel=1e-3)
+        assert float(s(jnp.array(1000))) == pytest.approx(0.0, abs=1e-9)
+
+    def test_one_cycle(self):
+        from deepspeed_tpu.runtime.lr_schedules import one_cycle
+        s = one_cycle(1e-4, 1e-3, cycle_first_step_size=100)
+        assert float(s(jnp.array(0))) == pytest.approx(1e-4)
+        assert float(s(jnp.array(100))) == pytest.approx(1e-3)
+        assert float(s(jnp.array(200))) == pytest.approx(1e-4)
+
+    def test_registry(self):
+        from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
+        for name, params in [("WarmupLR", {}), ("OneCycle",
+                             {"cycle_min_lr": 0, "cycle_max_lr": 1e-3}),
+                             ("LRRangeTest", {}), ("WarmupDecayLR",
+                             {"total_num_steps": 10})]:
+            s = get_lr_schedule(name, params)
+            assert np.isfinite(float(s(jnp.array(5))))
+
+
+class TestLossScaler:
+    def test_dynamics(self):
+        from deepspeed_tpu.runtime.fp16 import DynamicLossScaler
+        sc = DynamicLossScaler(initial_scale_power=4, scale_window=2,
+                               hysteresis=1)
+        st = sc.init()
+        assert float(st.scale) == 16.0
+        ov = jnp.asarray(False)
+        st = sc.update(st, ov)
+        st = sc.update(st, ov)  # 2 good steps -> double
+        assert float(st.scale) == 32.0
+        st = sc.update(st, jnp.asarray(True))  # overflow -> halve
+        assert float(st.scale) == 16.0
+
+    def test_hysteresis(self):
+        from deepspeed_tpu.runtime.fp16 import DynamicLossScaler
+        sc = DynamicLossScaler(initial_scale_power=4, scale_window=100,
+                               hysteresis=2)
+        st = sc.init()
+        st = sc.update(st, jnp.asarray(True))  # first overflow tolerated
+        assert float(st.scale) == 16.0
+        st = sc.update(st, jnp.asarray(True))  # second -> halve
+        assert float(st.scale) == 8.0
+
+    def test_overflow_detection(self):
+        from deepspeed_tpu.runtime.fp16 import DynamicLossScaler
+        good = {"a": jnp.ones((3,))}
+        bad = {"a": jnp.array([1.0, jnp.inf, 0.0])}
+        assert not bool(DynamicLossScaler.has_overflow(good))
+        assert bool(DynamicLossScaler.has_overflow(bad))
