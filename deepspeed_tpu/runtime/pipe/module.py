@@ -1,0 +1,123 @@
+"""Pipeline module expression.
+
+Reference surface: ``LayerSpec`` (`/root/reference/deepspeed/runtime/pipe/
+module.py:24`), ``TiedLayerSpec``, ``PipelineModule`` (`module.py:86`) with
+layer partitioning by 'parameters' | 'uniform' | 'type:regex'
+(`_partition_layers` :365, balancing via `partition_balanced`
+`runtime/utils.py:639`).
+
+TPU redesign: a pipeline stage is not a set of processes executing a module
+shard — it is a slice of a **stage-stacked parameter pytree** (leaves carry a
+leading ``[S, layers_per_stage, ...]`` axis, sharded over the ``pipe`` mesh
+axis) driven by one compiled microbatch loop (see `pipe/engine.py`). This
+module computes the partition (which layer goes to which stage) and builds
+the stacked pytree; tied layers (`TiedLayerSpec`) stay replicated over
+``pipe`` — shard_map's transpose then produces exactly the reference's
+tied-gradient all-reduce (`pipe/engine.py:233` _exec_reduce_tied_grads).
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..utils import partition_balanced, partition_uniform, tree_param_count
+
+
+class LayerSpec:
+    """Deferred layer: build params with ``init(rng)``, run with
+    ``apply(params, x)``. Reference `pipe/module.py:24` (defers nn.Module
+    construction so only the owning stage materializes weights — here
+    materialization is sharded by jit, so the deferral is just structure)."""
+
+    def __init__(self, init_fn: Callable, apply_fn: Callable,
+                 typename: str = "Layer"):
+        self.init_fn = init_fn
+        self.apply_fn = apply_fn
+        self.typename = typename
+
+    def build(self, rng):
+        return self.init_fn(rng)
+
+    def param_count(self) -> int:
+        shapes = jax.eval_shape(lambda: self.init_fn(jax.random.PRNGKey(0)))
+        return tree_param_count(shapes)
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer whose params are shared across stages by key (reference
+    `pipe/module.py:56` — e.g. tied input/output embeddings)."""
+
+    def __init__(self, key: str, init_fn, apply_fn, typename="TiedLayer",
+                 forward_fn: Optional[Callable] = None):
+        super().__init__(init_fn, apply_fn, typename)
+        self.key = key
+        self.forward_fn = forward_fn or apply_fn
+
+
+def partition_layers(layer_specs: Sequence[LayerSpec], num_stages: int,
+                     method: str = "parameters") -> List[int]:
+    """Stage boundaries over the layer list. Reference
+    `pipe/module.py:365` _partition_layers."""
+    n = len(layer_specs)
+    method = method.lower()
+    if method == "uniform":
+        return partition_uniform(n, num_stages)
+    if method == "parameters":
+        weights = [max(1, s.param_count()) for s in layer_specs]
+        return partition_balanced(weights, num_stages)
+    if method.startswith("type:"):
+        pat = re.compile(method[5:], re.IGNORECASE)
+        weights = [1 if pat.search(s.typename) else 0 for s in layer_specs]
+        return partition_balanced([max(w, 0) + 1e-9 for w in weights],
+                                  num_stages)
+    raise ValueError(f"Unknown partition method {method}")
+
+
+class PipelineModule:
+    """A model expressed as a flat layer list, to be executed by the
+    pipeline engine. Reference `pipe/module.py:86`.
+
+    The engine currently requires homogeneous stages (equal layer counts and
+    matching layer param structures) so stages stack into one scanned pytree
+    — the partition method still decides WHICH layers group together, and
+    `boundaries` is exposed for inspection/tests.
+    """
+
+    def __init__(self, layers: Sequence[LayerSpec], num_stages: int,
+                 partition_method: str = "parameters",
+                 loss_fn: Optional[Callable] = None):
+        self.layer_specs = list(layers)
+        self.num_stages = num_stages
+        self.partition_method = partition_method
+        self.loss_fn = loss_fn
+        self.boundaries = partition_layers(self.layer_specs, num_stages,
+                                           partition_method)
+        self.tied_keys = sorted({s.key for s in self.layer_specs
+                                 if isinstance(s, TiedLayerSpec)})
+
+    def stage_layers(self, stage_id: int) -> List[LayerSpec]:
+        lo, hi = self.boundaries[stage_id], self.boundaries[stage_id + 1]
+        return self.layer_specs[lo:hi]
+
+    def init(self, rng) -> Dict[str, Any]:
+        """Build {"tied": {key: params}, "stages": [per-stage layer param
+        lists]} — the engine stacks homogeneous stages afterwards."""
+        keys = jax.random.split(rng, len(self.layer_specs) + 1)
+        tied: Dict[str, Any] = {}
+        stages = []
+        for sid in range(self.num_stages):
+            lo, hi = self.boundaries[sid], self.boundaries[sid + 1]
+            layer_params = []
+            for li in range(lo, hi):
+                spec = self.layer_specs[li]
+                if isinstance(spec, TiedLayerSpec):
+                    if spec.key not in tied:
+                        tied[spec.key] = spec.build(keys[li])
+                    layer_params.append({"__tied__": spec.key})
+                else:
+                    layer_params.append(spec.build(keys[li]))
+            stages.append(layer_params)
+        return {"tied": tied, "stages": stages}
