@@ -50,7 +50,10 @@ def dense_init(rng, in_dim: int, out_dim: int, use_bias: bool = True,
 
 
 def dense_apply(params, x, *, precision=None):
-    y = jnp.einsum("...i,io->...o", x, params["kernel"], precision=precision)
+    # Kernel is cast to the activation dtype so fp32 master params don't
+    # silently promote the whole stream to fp32 (bf16 in → bf16 out).
+    y = jnp.einsum("...i,io->...o", x, params["kernel"].astype(x.dtype),
+                   precision=precision)
     if "bias" in params:
         y = y + params["bias"].astype(y.dtype)
     return y
