@@ -62,16 +62,17 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     async_save = engine._config.checkpoint_config.async_save
 
     ckptr = _checkpointer(async_save)
+    if async_save:
+        # Publish any prior save's meta/latest now. AsyncCheckpointer.save
+        # itself blocks on the previous commit, so this adds no waiting —
+        # and it bounds hard-kill metadata loss to the single in-flight
+        # checkpoint rather than every checkpoint since the last load.
+        wait_pending()
     state = dict(engine.state)
     scaler = state.pop("scaler", None)
     if scaler is not None:
         state["scaler"] = dict(scaler._asdict())
     ckptr.save(os.path.join(path, "state"), state, force=True)
-    if async_save:
-        # 'latest' must only point at a committed checkpoint: defer the tag
-        # write until the background commit finishes (wait_pending), so a
-        # crash mid-write leaves 'latest' on the previous good checkpoint.
-        _PENDING_TAGS.append((save_dir, tag))
 
     meta = {
         "tag": tag,
@@ -84,30 +85,49 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "client_state": client_state or {},
         "ds_version": "deepspeed_tpu-0.1.0",
     }
-    os.makedirs(path, exist_ok=True)
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump(meta, f, indent=2, default=str)
-    if not async_save:
-        with open(os.path.join(save_dir, "latest"), "w") as f:
-            f.write(str(tag))
+    if async_save:
+        # A tag dir must be complete iff the state committed: defer BOTH the
+        # meta.json write and the 'latest' publish until the background
+        # commit finishes (wait_pending). A crash mid-commit then leaves a
+        # tag dir with no meta.json — load_checkpoint(tag=...) rejects it
+        # cleanly instead of failing deep inside orbax. Paths are resolved
+        # NOW so a later chdir can't redirect the publish, and an atexit
+        # hook guarantees the publish runs even if the caller never loads.
+        global _ATEXIT_REGISTERED
+        if not _ATEXIT_REGISTERED:
+            import atexit
+            atexit.register(wait_pending)
+            _ATEXIT_REGISTERED = True
+        _PENDING_TAGS.append((os.path.abspath(save_dir), tag, meta))
+    else:
+        _publish(os.path.abspath(save_dir), tag, meta)
     logger.info(f"saved checkpoint {path}" +
                 (" (async)" if async_save else ""))
     return path
 
 
 _PENDING_TAGS: list = []
+_ATEXIT_REGISTERED = False
+
+
+def _publish(save_dir: str, tag: str, meta: dict) -> None:
+    """Make a tag dir loadable: write meta.json, point 'latest' at it."""
+    path = _tag_path(save_dir, tag)
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2, default=str)
+    with open(os.path.join(save_dir, "latest"), "w") as f:
+        f.write(str(tag))
 
 
 def wait_pending(engine=None) -> None:
     """Block until async saves commit (orbax wait_until_finished), then
-    publish their 'latest' tags."""
+    publish their meta.json + 'latest' tags."""
     for c in _ASYNC_CKPTRS.values():
         if hasattr(c, "wait_until_finished"):
             c.wait_until_finished()
     while _PENDING_TAGS:
-        save_dir, tag = _PENDING_TAGS.pop(0)
-        with open(os.path.join(save_dir, "latest"), "w") as f:
-            f.write(str(tag))
+        _publish(*_PENDING_TAGS.pop(0))
 
 
 def _validate_tag(engine, save_dir: str, tag: Optional[str]):
@@ -142,7 +162,12 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         raise FileNotFoundError(f"checkpoint {path} not found")
 
     import orbax.checkpoint as ocp
-    with open(os.path.join(path, "meta.json")) as f:
+    meta_path = os.path.join(path, "meta.json")
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(
+            f"checkpoint {path} exists but has no meta.json — it was never "
+            f"committed (crashed mid-save?); pick a committed tag")
+    with open(meta_path) as f:
         meta = json.load(f)
 
     shardings = engine.state_shardings()

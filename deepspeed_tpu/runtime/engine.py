@@ -226,6 +226,12 @@ class DeepSpeedEngine:
             lambda p: p.astype(self.compute_dtype)
             if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
 
+    def _current_scale(self, state):
+        """The live loss scale as a traced f32 scalar (1.0 when no scaler)."""
+        if self.loss_scaler is not None:
+            return state["scaler"].scale
+        return jnp.asarray(1.0, jnp.float32)
+
     def _micro_loss(self, params, micro_batch, scale):
         loss = self._loss_fn(self._cast_for_compute(params), micro_batch)
         return loss * scale
@@ -246,15 +252,15 @@ class DeepSpeedEngine:
         clip_grad_norm_ (`runtime/utils.py:325`), optimizer.step, loss-scale
         update, skip-on-overflow (`fp16/fused_optimizer.py`)."""
         cfg = self._config
-        scale = (state["scaler"].scale if self.loss_scaler is not None
-                 else jnp.asarray(1.0, jnp.float32))
+        scale = self._current_scale(state)
         denom = scale * n_micro
         grads = jax.tree_util.tree_map(
             lambda g: g.astype(jnp.float32) / denom, grads)
         grads = constrain(grads, self.mesh, self.grad_specs)
 
         if overflow is None:
-            if self.loss_scaler is not None:
+            if self.loss_scaler is not None and \
+                    self.loss_scaler.detect_overflow:
                 overflow = DynamicLossScaler.has_overflow(grads)
             else:
                 overflow = jnp.asarray(False)
@@ -295,8 +301,7 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps
 
         def step_fn(state, batch):
-            scale = (state["scaler"].scale if self.loss_scaler is not None
-                     else jnp.asarray(1.0, jnp.float32))
+            scale = self._current_scale(state)
 
             def micro(carry, mb):
                 gsum, lsum = carry

@@ -24,6 +24,12 @@ class LossScaleState(NamedTuple):
 
 
 class DynamicLossScaler:
+    # When False (static scaling), overflow is never detected and steps are
+    # never skipped — matching the reference LossScaler whose has_overflow
+    # always returns False (`fp16/loss_scaler.py:53`); non-finite grads then
+    # propagate into params exactly as they would in the reference.
+    detect_overflow: bool = True
+
     def __init__(self, initial_scale_power: int = 16, scale_window: int = 1000,
                  min_scale: float = 1.0, hysteresis: int = 2,
                  scale_factor: float = 2.0):
@@ -66,8 +72,11 @@ class DynamicLossScaler:
 
 def static_loss_scaler(scale: float) -> DynamicLossScaler:
     """Fixed-scale degenerate case (reference ``LossScaler``,
-    `loss_scaler.py:53`)."""
+    `loss_scaler.py:53`): the scale never moves AND overflow is never
+    detected, so updates are never skipped — the user opted out of the
+    safety net by picking a static scale."""
     s = DynamicLossScaler(initial_scale_power=0, scale_window=1 << 30,
                           min_scale=scale, hysteresis=1, scale_factor=1.0)
     s.initial_scale = scale
+    s.detect_overflow = False
     return s

@@ -204,10 +204,18 @@ class PipelineEngine(DeepSpeedEngine):
             x = jnp.where(sid == 0, embed_fn(tok_in), recv)
             y = stage_fn(x)
             tok_out = ids[jnp.clip(tt - (s - 1), 0, m - 1)]
-            ls, ct = head_loss(y, tok_out)
-            valid = jnp.logical_and(sid == s - 1, tt >= s - 1).astype(
-                jnp.float32)
-            return (y, lsum + ls * valid, cnt + ct * valid), None
+            # Only the last stage at ticks >= S-1 holds a real microbatch
+            # output; every other (stage, tick) skips the vocab projection
+            # entirely (cond, not select — the head is the single most
+            # expensive op in the loop). Safe under manual 'pipe': the
+            # predicate is uniform within a stage, so 'model'-axis (auto)
+            # collectives inside the branch stay consistent per stage.
+            valid = jnp.logical_and(sid == s - 1, tt >= s - 1)
+            ls, ct = jax.lax.cond(
+                valid, lambda: head_loss(y, tok_out),
+                lambda: (jnp.zeros((), jnp.float32),
+                         jnp.zeros((), jnp.float32)))
+            return (y, lsum + ls, cnt + ct), None
 
         state0 = jnp.zeros((mb, t, cfg.d_model), cfg.dtype)
         (_, lsum, cnt), _ = jax.lax.scan(
@@ -229,15 +237,20 @@ class PipelineEngine(DeepSpeedEngine):
 
         def step_fn(state, batch):
             ids = batch["input_ids"]        # [M, mb, T]
+            # fp16: scale the loss BEFORE autodiff so small grads survive the
+            # half-precision backward; _apply_grads divides the sum back out
+            # (reference FP16_Optimizer.backward, fp16/fused_optimizer.py).
+            scale = self._current_scale(state)
 
             def loss_of(params):
-                return sharded_loss(self._cast_for_compute(params), ids)
+                return sharded_loss(self._cast_for_compute(params),
+                                    ids) * scale
 
             loss, grads = jax.value_and_grad(loss_of)(state["params"])
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32), grads)
             new_state, metrics = self._apply_grads(state, grads, 1.0)
-            metrics["loss"] = loss
+            metrics["loss"] = loss / scale
             return new_state, metrics
 
         with self.mesh:

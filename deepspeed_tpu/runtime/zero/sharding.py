@@ -150,21 +150,56 @@ class ZeroShardingPolicy:
         return self.param_specs
 
     def opt_state_specs(self, opt_state_shapes):
-        """Map a params-shaped subtree inside the optimizer state to sharded
-        specs; scalar leaves (step counters) replicate."""
+        """Map every params-shaped subtree inside the optimizer state to
+        sharded specs; scalar leaves (step counters) replicate.
+
+        Recurses to ANY depth so wrapped optax states match too — e.g.
+        ScaleByAdamState.mu/nu nested inside a chain tuple (the reference
+        shards whatever tensors the optimizer holds, stage_1_and_2.py:638
+        initialize_optimizer_states)."""
         moment_specs = (self._sharded_tree(exclude_scan_dim=True)
                         if self.stage >= 1 else self.param_specs)
         params_treedef = jax.tree_util.tree_structure(self.param_shapes)
+        param_leaf_shapes = [
+            tuple(getattr(x, "shape", ())) for x in
+            jax.tree_util.tree_leaves(self.param_shapes)]
+        found = [False]
 
-        def map_state(subtree):
-            if jax.tree_util.tree_structure(subtree) == params_treedef:
+        def matches(subtree) -> bool:
+            try:
+                if jax.tree_util.tree_structure(subtree) != params_treedef:
+                    return False
+                return [tuple(getattr(x, "shape", ())) for x in
+                        jax.tree_util.tree_leaves(subtree)] == \
+                    param_leaf_shapes
+            except Exception:
+                return False
+
+        def replicate(leaf):
+            return P(*([None] * len(getattr(leaf, "shape", ()))))
+
+        # is_leaf=matches stops descent exactly at params-shaped subtrees;
+        # everything else (including registered pytree nodes — dataclass
+        # optimizer states etc.) is traversed by tree_map itself.
+        def map_node(node):
+            if matches(node):
+                found[0] = True
                 return moment_specs
-            return jax.tree_util.tree_map(
-                lambda leaf: P(*([None] * len(leaf.shape))), subtree)
+            return replicate(node)
 
-        if isinstance(opt_state_shapes, dict):
-            return {k: map_state(v) for k, v in opt_state_shapes.items()}
-        return map_state(opt_state_shapes)
+        specs = jax.tree_util.tree_map(map_node, opt_state_shapes,
+                                       is_leaf=matches)
+        has_tensor_state = any(
+            len(getattr(l, "shape", ())) > 0
+            for l in jax.tree_util.tree_leaves(opt_state_shapes))
+        if self.stage >= 1 and not found[0] and has_tensor_state:
+            from ...utils.logging import logger
+            logger.warning(
+                "ZeRO stage %d: no params-shaped subtree found in the "
+                "optimizer state — optimizer state will be fully replicated "
+                "(no memory saving). Check the optimizer's state layout.",
+                self.stage)
+        return specs
 
 
 def to_named(mesh: Mesh, spec_tree):
