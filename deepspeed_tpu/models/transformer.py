@@ -61,10 +61,44 @@ class TransformerConfig:
     # tokens (0 = off) keeps only [B,chunk,V] live, rematerializing per chunk
     # in backward.
     loss_chunk: int = 512
+    # -- MoE (reference deepspeed/moe/layer.py:15 MoE surface) --------------
+    moe_num_experts: int = 0           # 0 → dense model
+    moe_freq: int = 2                  # 1 = every layer, 2 = every other
+    moe_k: int = 1                     # top-1 or top-2 gating
+    moe_capacity_factor: float = 1.0
+    moe_eval_capacity_factor: float = 1.0
+    moe_min_capacity: int = 4
+    moe_use_residual: bool = False     # PR-MoE
+    moe_noisy_gate_policy: Optional[str] = None
+    moe_use_rts: bool = True
+    moe_aux_loss_coef: float = 0.01
+    moe_d_ff: int = 0                  # 0 → ff_dim
 
     @property
     def ff_dim(self) -> int:
         return self.d_ff or 4 * self.d_model
+
+    @property
+    def moe_enabled(self) -> bool:
+        return self.moe_num_experts > 0
+
+    @property
+    def scan_length(self) -> int:
+        """Number of scanned superblocks (layers per superblock =
+        ``moe_freq`` when MoE is on, else 1)."""
+        if not self.moe_enabled:
+            return self.num_layers
+        if self.moe_freq not in (1, 2):
+            raise ValueError("moe_freq must be 1 or 2")
+        if self.num_layers % self.moe_freq:
+            raise ValueError(
+                f"num_layers ({self.num_layers}) must divide by moe_freq "
+                f"({self.moe_freq})")
+        return self.num_layers // self.moe_freq
+
+    @property
+    def attn_per_block(self) -> int:
+        return self.moe_freq if self.moe_enabled else 1
 
     @property
     def hdim(self) -> int:
@@ -130,6 +164,21 @@ class TransformerLM:
             self._cos, self._sin = L.rotary_freqs(
                 config.hdim, config.rotary_dim, config.max_seq_len,
                 config.rotary_base)
+        if config.moe_enabled:
+            from ..moe.layer import MoEConfig, MoELayer
+            self._moe = MoELayer(
+                config.d_model,
+                MoEConfig(num_experts=config.moe_num_experts,
+                          k=config.moe_k,
+                          capacity_factor=config.moe_capacity_factor,
+                          eval_capacity_factor=config.moe_eval_capacity_factor,
+                          min_capacity=config.moe_min_capacity,
+                          use_residual=config.moe_use_residual,
+                          noisy_gate_policy=config.moe_noisy_gate_policy,
+                          use_rts=config.moe_use_rts,
+                          aux_loss_coef=config.moe_aux_loss_coef),
+                d_ff=config.moe_d_ff or config.ff_dim,
+                depth_scale=config.num_layers)
 
     # -- init --------------------------------------------------------------
     def init(self, rng) -> Dict:
@@ -140,12 +189,12 @@ class TransformerLM:
         norm_init = (L.layernorm_init if c.norm_type == "layernorm"
                      else L.rmsnorm_init)
 
-        def stack(init_fn, key, n=c.num_layers):
+        def stack(init_fn, key, n=c.scan_length):
             ks = jax.random.split(key, n)
             return jax.vmap(init_fn)(ks)
 
-        def block_init(k):
-            k1, k2, k3, k4 = jax.random.split(k, 4)
+        def attn_block_init(k):
+            k1, k2 = jax.random.split(k, 2)
             blk = {
                 "ln1": norm_init(None, d, dt),
                 "attn": {
@@ -154,20 +203,40 @@ class TransformerLM:
                                                     c.num_layers, dt)},
                 },
                 "ln2": norm_init(None, d, dt),
-                "mlp": {
-                    "fc_in": L.dense_init(k3, d, f, c.use_bias, 0.02, dt),
-                    "fc_out": {"kernel": L.scaled_init(k4, (f, d), 0.02,
-                                                       c.num_layers, dt)},
-                },
             }
             if c.use_bias:
                 blk["attn"]["out"]["bias"] = jnp.zeros((d,), dt)
+            return blk
+
+        def block_init(k):
+            ka, k3, k4 = jax.random.split(k, 3)
+            blk = attn_block_init(ka)
+            blk["mlp"] = {
+                "fc_in": L.dense_init(k3, d, f, c.use_bias, 0.02, dt),
+                "fc_out": {"kernel": L.scaled_init(k4, (f, d), 0.02,
+                                                   c.num_layers, dt)},
+            }
+            if c.use_bias:
                 blk["mlp"]["fc_out"]["bias"] = jnp.zeros((d,), dt)
             return blk
 
+        def moe_block_init(k):
+            ka, km = jax.random.split(k, 2)
+            blk = attn_block_init(ka)
+            blk["moe"] = self._moe.init(km, dt)
+            return blk
+
+        def superblock_init(k):
+            if not c.moe_enabled:
+                return block_init(k)
+            if c.moe_freq == 1:
+                return {"moe_blk": moe_block_init(k)}
+            kd, km = jax.random.split(k, 2)
+            return {"dense": block_init(kd), "moe_blk": moe_block_init(km)}
+
         params = {
             "embed": L.embedding_init(keys[0], c.vocab_size, d, 0.02, dt),
-            "blocks": stack(block_init, keys[1]),
+            "blocks": stack(superblock_init, keys[1]),
             "ln_f": norm_init(None, d, dt),
         }
         if c.pos_embedding == "learned":
@@ -241,15 +310,60 @@ class TransformerLM:
             x = x + self._mlp(bp["mlp"], norm(bp["ln2"], x))
         return self.constrain(x), new_cache
 
-    def _remat_block(self):
-        """Wrap the block with the configured rematerialization policy —
+    def _moe_block(self, bp, x, cache_kv=None, positions=None, rng=None,
+                   train=True):
+        """Attention + MoE-FFN block. Returns (x, new_cache, l_aux)."""
+        c = self.config
+        norm = (L.layernorm_apply if c.norm_type == "layernorm"
+                else L.rmsnorm_apply)
+        norm = partial(norm, eps=c.layernorm_eps)
+        x = self.constrain(x)
+        a, new_cache = self._attention(bp["attn"], norm(bp["ln1"], x),
+                                       cache_kv, positions)
+        if c.parallel_residual:
+            m, laux, _ = self._moe.apply(bp["moe"], norm(bp["ln2"], x),
+                                         rng=rng, train=train)
+            x = x + a + m
+        else:
+            x = x + a
+            m, laux, _ = self._moe.apply(bp["moe"], norm(bp["ln2"], x),
+                                         rng=rng, train=train)
+            x = x + m
+        return self.constrain(x), new_cache, laux
+
+    def _superblock(self, sp, x, caches=None, positions=None, rng=None,
+                    train=True):
+        """One scanned unit: a dense block (moe_freq=2 only) followed by a
+        MoE block, or just a dense block when MoE is off.
+
+        ``caches`` — tuple of per-attention-layer (ck, cv, idx) or None.
+        Returns (x, new_caches tuple | None, l_aux)."""
+        c = self.config
+        if not c.moe_enabled:
+            y, nc = self._block(sp, x, caches[0] if caches else None,
+                                positions)
+            return y, ((nc,) if caches else None), jnp.zeros((), jnp.float32)
+        new_caches = []
+        if c.moe_freq == 2:
+            x, nc = self._block(sp["dense"], x,
+                                caches[0] if caches else None, positions)
+            new_caches.append(nc)
+        x, nc, laux = self._moe_block(
+            sp["moe_blk"], x, caches[-1] if caches else None, positions,
+            rng, train)
+        new_caches.append(nc)
+        return x, (tuple(new_caches) if caches else None), laux
+
+    # (no separate _remat_block: callers wrap their scan body with _remat)
+    def _remat(self, fn):
+        """Wrap fn with the configured rematerialization policy —
         replaces the reference's activation-checkpointing subsystem
         (`runtime/activation_checkpointing/checkpointing.py:498`).
         ``dots_no_batch`` is the transformer sweet spot: dense matmul outputs
         are saved, the O(T²) attention scores are recomputed in backward."""
         c = self.config
         if c.remat == "none":
-            return self._block
+            return fn
         policy = {
             "full": None,
             "dots_saveable": jax.checkpoint_policies.dots_saveable,
@@ -257,7 +371,7 @@ class TransformerLM:
                 jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
         }[c.remat]
-        return jax.checkpoint(self._block, policy=policy)
+        return jax.checkpoint(fn, policy=policy)
 
     # -- full forward ------------------------------------------------------
     def apply(self, params, input_ids, cache=None, positions=None):
@@ -268,7 +382,10 @@ class TransformerLM:
         """
         c = self.config
         if cache is None:
-            return self._project(params, self.hidden_states(params, input_ids))
+            # inference semantics: eval capacity factor, no gate noise —
+            # same gating mode as the cached decode branch below
+            x, _ = self.hidden_states_and_aux(params, input_ids, train=False)
+            return self._project(params, x)
 
         idx = cache["index"]
         if positions is None:
@@ -278,10 +395,22 @@ class TransformerLM:
         if c.pos_embedding == "learned":
             x = x + L.embedding_apply(params["pos_embed"], positions, c.dtype)
 
-        def scan_fn(carry, xs):
-            bp, ck, cv = xs
-            y, kv = self._block(bp, carry, (ck, cv, idx), positions)
-            return y, kv
+        if c.moe_enabled:
+            # cache leaves: [scan, A, B, T, H, Dh], A = attns per superblock
+            def scan_fn(carry, xs):
+                sp, ck, cv = xs
+                caches = tuple((ck[i], cv[i], idx)
+                               for i in range(c.attn_per_block))
+                y, ncs, _ = self._superblock(sp, carry, caches, positions,
+                                             rng=None, train=False)
+                nk = jnp.stack([nc[0] for nc in ncs])
+                nv = jnp.stack([nc[1] for nc in ncs])
+                return y, (nk, nv)
+        else:
+            def scan_fn(carry, xs):
+                bp, ck, cv = xs
+                y, kv = self._block(bp, carry, (ck, cv, idx), positions)
+                return y, kv
         x, (nk, nv) = jax.lax.scan(scan_fn, x,
                                    (params["blocks"], cache["k"], cache["v"]))
         new_cache = {"k": nk, "v": nv, "index": idx + input_ids.shape[1]}
@@ -297,27 +426,50 @@ class TransformerLM:
                           params["lm_head"]["kernel"].astype(x.dtype),
                           preferred_element_type=jnp.float32)
 
-    def hidden_states(self, params, input_ids):
-        """Forward up to the final norm, pre-projection ([B,T,D])."""
+    def hidden_states_and_aux(self, params, input_ids, rng=None, train=True):
+        """Forward up to the final norm → ([B,T,D], moe_aux_loss scalar)."""
         c = self.config
         x = L.embedding_apply(params["embed"], input_ids, c.dtype)
         if c.pos_embedding == "learned":
             pos = jnp.arange(input_ids.shape[1])[None, :]
             x = x + L.embedding_apply(params["pos_embed"], pos, c.dtype)
-        block = self._remat_block()
 
-        def scan_fn(carry, bp):
-            y, _ = block(bp, carry)
-            return y, None
-        x, _ = jax.lax.scan(scan_fn, x, params["blocks"])
+        def sb_fn(sp, x, key):
+            y, _, la = self._superblock(sp, x, None, None, key, train)
+            return y, la
+        sb = self._remat(sb_fn)
+        zero = jnp.zeros((), jnp.float32)
+
+        if rng is not None and c.moe_enabled:
+            keys = jax.random.split(rng, c.scan_length)
+
+            def scan_fn(carry, xs):
+                sp, key = xs
+                y, la = sb(sp, carry[0], key)
+                return (y, carry[1] + la), None
+            (x, laux), _ = jax.lax.scan(scan_fn, (x, zero),
+                                        (params["blocks"], keys))
+        else:
+            def scan_fn(carry, sp):
+                y, la = sb(sp, carry[0], None)
+                return (y, carry[1] + la), None
+            (x, laux), _ = jax.lax.scan(scan_fn, (x, zero), params["blocks"])
         norm = (L.layernorm_apply if c.norm_type == "layernorm"
                 else L.rmsnorm_apply)
-        return norm(params["ln_f"], x, eps=c.layernorm_eps)
+        return norm(params["ln_f"], x, eps=c.layernorm_eps), laux
+
+    def hidden_states(self, params, input_ids):
+        """Forward up to the final norm, pre-projection ([B,T,D])."""
+        return self.hidden_states_and_aux(params, input_ids)[0]
 
     def init_cache(self, batch: int, max_len: int, dtype=None) -> Dict:
         c = self.config
         dtype = dtype or c.dtype
-        shape = (c.num_layers, batch, max_len, c.num_heads, c.hdim)
+        if c.moe_enabled:
+            shape = (c.scan_length, c.attn_per_block, batch, max_len,
+                     c.num_heads, c.hdim)
+        else:
+            shape = (c.num_layers, batch, max_len, c.num_heads, c.hdim)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
                 "index": jnp.array(0, jnp.int32)}
 
@@ -339,12 +491,22 @@ class TransformerLM:
             last_mask = jnp.ones_like(ids, dtype=jnp.float32).at[:, -1].set(0.0)
             mask = last_mask if mask is None else mask * last_mask
 
+        # Optional per-step gate randomness (RTS / noisy gating): pass
+        # batch["moe_rng"] = jax.random.PRNGKey(step) to engine.train_step —
+        # the engine splits it into one key per microbatch (shard_batch) and
+        # the GAS scan delivers a (2,)-shaped key here. Absent = deterministic
+        # routing.
+        moe_rng = batch.get("moe_rng")
+        aux_coef = (self.config.moe_aux_loss_coef
+                    if self.config.moe_enabled else 0.0)
+
         chunk = self.config.loss_chunk
         t = labels.shape[1]
         if chunk and t > chunk and t % chunk == 0:
             # Chunked CE: never materialize [B,T,V]; per chunk the projection
             # + logsumexp recompute in backward (jax.checkpoint).
-            x = self.hidden_states(params, logits_in)  # [B,T,D]
+            x, laux = self.hidden_states_and_aux(params, logits_in,
+                                                 rng=moe_rng)  # [B,T,D]
             n_chunks = t // chunk
 
             def to_chunks(a):
@@ -371,52 +533,70 @@ class TransformerLM:
             (tot, cnt), _ = jax.lax.scan(
                 body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
                 (to_chunks(x), to_chunks(labels), mc_all))
-            return tot / jnp.maximum(cnt, 1.0)
+            return tot / jnp.maximum(cnt, 1.0) + aux_coef * laux
 
-        logits = self.apply(params, logits_in)
+        x, laux = self.hidden_states_and_aux(params, logits_in, rng=moe_rng)
+        logits = self._project(params, x)
         # logsumexp form avoids materializing the full [B,T,V] log-prob array
         # (matters at vocab 50k: that array is the single biggest HBM tensor).
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         tgt = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
         nll = lse - tgt
         if mask is None:
-            return jnp.mean(nll)
+            return jnp.mean(nll) + aux_coef * laux
         mask = mask.astype(nll.dtype)
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return (jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+                + aux_coef * laux)
 
     # -- partitioning ------------------------------------------------------
+    # TP rules keyed on the TRAILING (module, weight) path pair — depth-
+    # independent so dense blocks, MoE superblocks, and stacked expert trees
+    # all resolve. Specs are for the weight's own dims; leading stack axes
+    # (scan layer axis, expert axis) are prepended in spec_for.
+    _SUFFIX_RULES = {
+        ("embed", "embedding"): ("model", None),
+        ("pos_embed", "embedding"): (None, None),
+        ("qkv", "kernel"): (None, "model"),
+        ("qkv", "bias"): ("model",),
+        ("out", "kernel"): ("model", None),
+        ("out", "bias"): (None,),
+        ("fc_in", "kernel"): (None, "model"),
+        ("fc_in", "bias"): ("model",),
+        ("fc_out", "kernel"): ("model", None),
+        ("fc_out", "bias"): (None,),
+        ("lm_head", "kernel"): (None, "model"),
+    }
+
     def partition_specs(self, params=None) -> Dict:
         """Params-shaped PartitionSpec tree: tensor-parallel layout over the
         ``model`` mesh axis (Megatron-style column/row split — role of the
         reference's `module_inject/replace_module.py:23` ReplaceWithTensorSlicing,
-        decided here declaratively). Leading axis of ``blocks`` leaves is the
-        scan/layer axis (never sharded)."""
-        rules = {
-            ("embed", "embedding"): P("model", None),
-            ("pos_embed", "embedding"): P(None, None),
-            ("blocks", "ln1", "scale"): P(None, None),
-            ("blocks", "ln1", "bias"): P(None, None),
-            ("blocks", "ln2", "scale"): P(None, None),
-            ("blocks", "ln2", "bias"): P(None, None),
-            ("blocks", "attn", "qkv", "kernel"): P(None, None, "model"),
-            ("blocks", "attn", "qkv", "bias"): P(None, "model"),
-            ("blocks", "attn", "out", "kernel"): P(None, "model", None),
-            ("blocks", "attn", "out", "bias"): P(None, None),
-            ("blocks", "mlp", "fc_in", "kernel"): P(None, None, "model"),
-            ("blocks", "mlp", "fc_in", "bias"): P(None, "model"),
-            ("blocks", "mlp", "fc_out", "kernel"): P(None, "model", None),
-            ("blocks", "mlp", "fc_out", "bias"): P(None, None),
-            ("ln_f", "scale"): P(None,),
-            ("ln_f", "bias"): P(None,),
-            ("lm_head", "kernel"): P(None, "model"),
-        }
+        decided here declaratively); MoE expert stacks shard over ``expert``
+        (reference expert groups, `utils/groups.py:109`). Leading axis of
+        ``blocks`` leaves is the scan/layer axis (never sharded)."""
         if params is None:
             params = jax.eval_shape(lambda: self.init(jax.random.PRNGKey(0)))
+        # MoE subtrees defer to MoELayer's own spec tree (single source of
+        # truth — pluggable experts bring their own specs); only the leading
+        # scan axis is prepended here.
+        moe_specs = (self._moe.partition_specs()
+                     if self.config.moe_enabled else None)
 
-        def spec_for(path):
-            key = tuple(p.key for p in path)
-            if key in rules:
-                return rules[key]
-            raise KeyError(f"No partition rule for param {key}")
-        return jax.tree_util.tree_map_with_path(
-            lambda path, _: spec_for(path), params)
+        def spec_for(path, leaf):
+            keys = tuple(p.key for p in path)
+            ndim = len(leaf.shape)
+            if "moe" in keys:
+                sp = moe_specs
+                for k in keys[keys.index("moe") + 1:]:
+                    sp = sp[k]
+                return P(None, *sp)            # [scan, ...moe spec...]
+            if any(k.startswith("ln") for k in keys):  # norms replicate
+                inner = (None,) * (1 if keys[0] != "blocks" else ndim - 1)
+            else:
+                inner = self._SUFFIX_RULES.get(keys[-2:])
+                if inner is None:
+                    raise KeyError(f"No partition rule for param {keys}")
+            lead = [None] * (ndim - len(inner))
+            return P(*lead, *inner)
+
+        return jax.tree_util.tree_map_with_path(spec_for, params)

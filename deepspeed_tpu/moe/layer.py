@@ -1,0 +1,192 @@
+"""MoE layer: gate → dispatch → experts → combine, over the ``expert`` axis.
+
+Role-equivalent of the reference ``MoE`` / ``MOELayer`` / ``Experts``
+(`/root/reference/deepspeed/moe/layer.py:15`, `sharded_moe.py:439`,
+`moe/experts.py:9`). TPU-native shape of the design:
+
+  - Expert weights carry a leading ``E`` (num_experts) axis sharded over the
+    ``expert`` mesh axis — the reference's ``num_local_experts`` is simply
+    E / ep_size shards of that axis, and its per-group expert process groups
+    (`utils/groups.py:109` _create_expert_and_data_parallel) collapse into
+    the one mesh.
+  - The [E, C, M] dispatched tensor is sharding-constrained to
+    P('expert', ...); with tokens sharded over the data-like axes, GSPMD
+    lowers the dispatch/combine einsums into exactly the all_to_all pair the
+    reference issues by hand (`sharded_moe.py:89` _AllToAll).
+  - Expert gradients need no special buckets (reference engine.py:2428
+    _reduce_expert_gradients): grads of expert-sharded params are reduced
+    over the remaining axes automatically by GSPMD's partitioner.
+  - PR-MoE residual experts (`layer.py` use_residual, arXiv 2201.05596):
+    a dense MLP branch mixed per-token via a learned 2-way coefficient.
+
+The expert itself is pluggable as an (init, apply) pair like everything in
+`models/layers.py`; `mlp_expert` is the standard FFN expert.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..models import layers as L
+from ..parallel.topology import EXPERT_AXIS
+from .sharded_moe import GateOutput, gate as topk_gate
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    """Mirrors the reference MoE.__init__ surface (`moe/layer.py:15`)."""
+    num_experts: int = 1
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    use_residual: bool = False
+    noisy_gate_policy: Optional[str] = None
+    use_rts: bool = True
+    aux_loss_coef: float = 0.01
+
+
+def mlp_expert(d_model: int, d_ff: int, activation: str = "gelu",
+               use_bias: bool = True, depth_scale: Optional[int] = None):
+    """Standard FFN expert (init, apply) pair.
+
+    ``depth_scale`` — total transformer depth; the residual-branch output
+    projection then uses the GPT-2 scaled init (0.02/sqrt(2L)) exactly like
+    the dense blocks' fc_out, keeping residual variance depth-controlled."""
+    def init(rng, dtype=jnp.float32):
+        k1, k2 = jax.random.split(rng)
+        if depth_scale:
+            out_kernel = L.scaled_init(k2, (d_ff, d_model), 0.02,
+                                       depth_scale, dtype)
+        else:
+            out_kernel = L.normal_init(k2, (d_ff, d_model), 0.02, dtype)
+        p = {"fc_in": L.dense_init(k1, d_model, d_ff, use_bias, 0.02, dtype),
+             "fc_out": {"kernel": out_kernel}}
+        if use_bias:
+            p["fc_out"]["bias"] = jnp.zeros((d_model,), dtype)
+        return p
+
+    def apply(p, x):
+        h = L.dense_apply(p["fc_in"], x)
+        h = L.ACT_FNS[activation](h)
+        return L.dense_apply(p["fc_out"], h)
+
+    def specs():
+        sp = {"fc_in": {"kernel": P(None, "model")},
+              "fc_out": {"kernel": P("model", None)}}
+        if use_bias:
+            sp["fc_in"]["bias"] = P("model")
+            sp["fc_out"]["bias"] = P(None)
+        return sp
+
+    return init, apply, specs
+
+
+class MoELayer:
+    """Functional MoE layer.
+
+    ``init(rng)`` → params; ``apply(params, x, rng=None, train=True)`` →
+    (y, l_aux, exp_counts). x: [..., M] (any leading batch dims).
+    """
+
+    def __init__(self, d_model: int, config: MoEConfig,
+                 expert: Optional[Tuple[Callable, Callable, Callable]] = None,
+                 d_ff: Optional[int] = None,
+                 constrain: Optional[Callable] = None,
+                 depth_scale: Optional[int] = None):
+        self.d_model = d_model
+        self.config = config
+        self.expert_init, self.expert_apply, self.expert_specs = (
+            expert if expert is not None
+            else mlp_expert(d_model, d_ff or 4 * d_model,
+                            depth_scale=depth_scale))
+        self.constrain = constrain or (lambda x, spec=None: x)
+
+    def init(self, rng, dtype=jnp.float32) -> Dict:
+        c = self.config
+        kg, ke, kr, kc = jax.random.split(rng, 4)
+        # gate weights stay fp32 — routing decisions are precision-critical
+        # (reference keeps the whole gate in fp32)
+        params = {
+            "gate": {"kernel": L.normal_init(kg, (self.d_model, c.num_experts),
+                                             0.02, jnp.float32)},
+            "experts": jax.vmap(lambda k: self.expert_init(k, dtype))(
+                jax.random.split(ke, c.num_experts)),
+        }
+        if c.use_residual:
+            params["residual_mlp"] = self.expert_init(kr, dtype)
+            params["coefficient"] = L.dense_init(kc, self.d_model, 2, True,
+                                                 0.02, dtype)
+        return params
+
+    def partition_specs(self) -> Dict:
+        """Experts shard over 'expert' on the leading E axis (+ TP inside
+        each expert over 'model'); gate + residual replicate over 'expert'."""
+        exp = self.expert_specs()
+        specs = {
+            "gate": {"kernel": P(None, None)},
+            "experts": jax.tree_util.tree_map(
+                lambda sp: P(EXPERT_AXIS, *sp), exp,
+                is_leaf=lambda x: isinstance(x, P)),
+        }
+        if self.config.use_residual:
+            specs["residual_mlp"] = exp
+            specs["coefficient"] = {"kernel": P(None, None),
+                                    "bias": P(None)}
+        return specs
+
+    _warned_no_rts_rng = False
+
+    def apply(self, params, x, rng: Optional[jax.Array] = None,
+              train: bool = True):
+        c = self.config
+        if (train and c.use_rts and rng is None
+                and not MoELayer._warned_no_rts_rng):
+            # trace-time, once: RTS without a key degrades to deterministic
+            # drop-by-token-order — legal, but the user asked for randomness
+            from ..utils.logging import logger
+            logger.warning(
+                "MoE use_rts=True but no rng provided (pass batch['moe_rng'] "
+                "through the engine); token selection is deterministic")
+            MoELayer._warned_no_rts_rng = True
+        orig_shape = x.shape
+        m = orig_shape[-1]
+        tokens = x.reshape(-1, m)                       # [S, M]
+        s = tokens.shape[0]
+
+        logits = jnp.einsum("sm,me->se", tokens.astype(jnp.float32),
+                            params["gate"]["kernel"])
+        out: GateOutput = topk_gate(
+            logits, c.k,
+            c.capacity_factor if train else c.eval_capacity_factor,
+            c.min_capacity, rng=rng,
+            noisy_gate_policy=c.noisy_gate_policy if train else None,
+            use_rts=c.use_rts and train)
+
+        # dispatch: [S,E,C] x [S,M] -> [E,C,M]; constraining to
+        # P('expert',...) makes GSPMD emit the token all_to_all here.
+        dispatched = jnp.einsum(
+            "sec,sm->ecm", out.dispatch_mask.astype(x.dtype), tokens)
+        dispatched = self.constrain(dispatched, P(EXPERT_AXIS, None, None))
+        expert_out = jax.vmap(self.expert_apply)(params["experts"],
+                                                 dispatched)   # [E, C, M]
+        expert_out = self.constrain(expert_out, P(EXPERT_AXIS, None, None))
+        # combine: the reverse all_to_all
+        combined = jnp.einsum("sec,ecm->sm",
+                              out.combine_weights.astype(x.dtype), expert_out)
+
+        if c.use_residual:
+            # PR-MoE (reference layer.py use_residual + moe/experts residual
+            # path): out = moe(x)*w0 + mlp(x)*w1, per-token softmax mix
+            mlp_out = self.expert_apply(params["residual_mlp"], tokens)
+            coef = jax.nn.softmax(
+                L.dense_apply(params["coefficient"], tokens).astype(
+                    jnp.float32), axis=-1)
+            combined = (combined * coef[:, 0:1].astype(x.dtype)
+                        + mlp_out * coef[:, 1:2].astype(x.dtype))
+
+        return (combined.reshape(orig_shape), out.l_aux, out.exp_counts)

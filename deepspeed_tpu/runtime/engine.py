@@ -130,8 +130,11 @@ class DeepSpeedEngine:
         opt_shapes = jax.eval_shape(self.optimizer.init, self._param_shapes)
         self.opt_specs = self.zero_policy.opt_state_specs(opt_shapes)
 
-        # batch leaves are [gas, global_batch, ...]
-        batch_axes = tuple(a for a in (topo.DCN_DATA_AXIS, topo.DATA_AXIS)
+        # batch leaves are [gas, global_batch, ...]; expert-parallel ranks
+        # are also data ranks (reference _create_expert_and_data_parallel,
+        # utils/groups.py:109), so the batch shards over 'expert' too
+        batch_axes = tuple(a for a in (topo.DCN_DATA_AXIS, topo.DATA_AXIS,
+                                       topo.EXPERT_AXIS)
                            if self.mesh.shape.get(a, 1) > 1)
         self._batch_dim_spec = batch_axes if batch_axes else None
 
@@ -237,13 +240,15 @@ class DeepSpeedEngine:
         return loss * scale
 
     def _batch_spec_tree(self, batch):
-        def spec(x):
+        def spec(path, x):
+            if path and getattr(path[-1], "key", None) == "moe_rng":
+                return P(*([None] * np.ndim(x)))   # rng keys replicate
             nd = np.ndim(x)
             entries = [None] * nd
             if nd >= 2:
                 entries[1] = self._batch_dim_spec
             return P(*entries)
-        return jax.tree_util.tree_map(spec, batch)
+        return jax.tree_util.tree_map_with_path(spec, batch)
 
     def _apply_grads(self, state, grads, n_micro: float, overflow=None):
         """Unscaled summed grads → clipped update → new state.
@@ -340,8 +345,19 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps
         global_b = self.train_batch_size
 
-        def prep(x):
+        def prep(k, x):
             x = np.asarray(x)
+            if k == "moe_rng":
+                # a single PRNG key: split into one key per microbatch so
+                # gate randomness (RTS / RSample) differs across the GAS scan
+                if x.shape == (2,):
+                    x = np.asarray(jax.random.split(
+                        jnp.asarray(x, jnp.uint32), gas))
+                if x.shape != (gas, 2):
+                    raise ValueError(
+                        f"moe_rng must be a PRNG key (2,) or per-microbatch "
+                        f"keys ({gas}, 2); got {x.shape}")
+                return x.astype(np.uint32)
             if x.ndim >= 1 and x.shape[0] == global_b:
                 return x.reshape((gas, global_b // gas) + x.shape[1:])
             if x.ndim >= 2 and x.shape[0] == gas:
@@ -349,7 +365,7 @@ class DeepSpeedEngine:
             raise ValueError(
                 f"batch leading dim {x.shape[0]} matches neither "
                 f"train_batch_size ({global_b}) nor [gas={gas}, ...] layout")
-        batch = {k: prep(v) for k, v in batch.items()}
+        batch = {k: prep(k, v) for k, v in batch.items()}
         shardings = to_named(self.mesh, self._batch_spec_tree(batch))
         return jax.device_put(batch, shardings)
 

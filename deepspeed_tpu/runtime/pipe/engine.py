@@ -50,14 +50,15 @@ class PipelinedLM:
 
     def __init__(self, model, num_stages: int):
         cfg = model.config
-        if cfg.num_layers % num_stages != 0:
+        n_scan = getattr(cfg, "scan_length", cfg.num_layers)
+        if n_scan % num_stages != 0:
             raise ValueError(
-                f"num_layers ({cfg.num_layers}) must divide evenly into "
+                f"scanned blocks ({n_scan}) must divide evenly into "
                 f"{num_stages} pipeline stages")
         self.model = model
         self.config = cfg
         self.num_stages = num_stages
-        self.layers_per_stage = cfg.num_layers // num_stages
+        self.layers_per_stage = n_scan // num_stages
 
     def init(self, rng):
         params = self.model.init(rng)
@@ -126,6 +127,13 @@ class PipelineEngine(DeepSpeedEngine):
         adapter = model if isinstance(model, PipelinedLM) else PipelinedLM(
             model, self.num_stages)
         self.adapter = adapter
+        mcfg = adapter.config
+        if getattr(mcfg, "moe_enabled", False) and \
+                mcfg.moe_noisy_gate_policy == "RSample":
+            raise NotImplementedError(
+                "RSample noisy gating has no rng path in the compiled "
+                "pipeline loop yet; use deterministic gating under "
+                "PipelineEngine")
         super().__init__(model=adapter, config=config, mesh=mesh, **kw)
 
     @property
@@ -186,23 +194,33 @@ class PipelineEngine(DeepSpeedEngine):
                 (to_chunks(x), to_chunks(labels), to_chunks(mask)))
             return tot, cnt2
 
-        block = model._remat_block()
+        def sb_fn(sp, x):
+            y, _, la = model._superblock(sp, x)
+            return y, la
+        sb = model._remat(sb_fn)
 
         def stage_fn(x):
-            def f(c, bp):
-                y, _ = block(bp, c)
-                return y, None
-            y, _ = jax.lax.scan(f, x, blocks_local)
-            return y
+            def f(c, sp):
+                y, la = sb(sp, c[0])
+                return (y, c[1] + la), None
+            (y, laux), _ = jax.lax.scan(
+                f, (x, jnp.zeros((), jnp.float32)), blocks_local)
+            return y, laux
 
         perm = [(i, (i + 1) % s) for i in range(s)]
 
         def tick(carry, tt):
-            state, lsum, cnt = carry
+            state, lsum, cnt, lauxsum = carry
             recv = jax.lax.ppermute(state, topo.PIPE_AXIS, perm)
             tok_in = ids[jnp.clip(tt, 0, m - 1)]
             x = jnp.where(sid == 0, embed_fn(tok_in), recv)
-            y = stage_fn(x)
+            y, laux = stage_fn(x)
+            # this stage holds a real microbatch only for ticks in
+            # [sid, sid + m); outside that window its input is pipeline
+            # bubble garbage and the aux loss must not count
+            valid_data = jnp.logical_and(tt >= sid, tt < sid + m).astype(
+                jnp.float32)
+            lauxsum = lauxsum + laux * valid_data
             tok_out = ids[jnp.clip(tt - (s - 1), 0, m - 1)]
             # Only the last stage at ticks >= S-1 holds a real microbatch
             # output; every other (stage, tick) skips the vocab projection
@@ -215,16 +233,21 @@ class PipelineEngine(DeepSpeedEngine):
                 valid, lambda: head_loss(y, tok_out),
                 lambda: (jnp.zeros((), jnp.float32),
                          jnp.zeros((), jnp.float32)))
-            return (y, lsum + ls, cnt + ct), None
+            return (y, lsum + ls, cnt + ct, lauxsum), None
 
         state0 = jnp.zeros((mb, t, cfg.d_model), cfg.dtype)
-        (_, lsum, cnt), _ = jax.lax.scan(
-            tick, (state0, jnp.zeros((), jnp.float32),
-                   jnp.zeros((), jnp.float32)),
-            jnp.arange(m + s - 1))
+        zero = jnp.zeros((), jnp.float32)
+        (_, lsum, cnt, lauxsum), _ = jax.lax.scan(
+            tick, (state0, zero, zero, zero), jnp.arange(m + s - 1))
         lsum = jax.lax.psum(lsum, topo.PIPE_AXIS)
         cnt = jax.lax.psum(cnt, topo.PIPE_AXIS)
-        return lsum / jnp.maximum(cnt, 1.0)
+        loss = lsum / jnp.maximum(cnt, 1.0)
+        if getattr(cfg, "moe_enabled", False):
+            # per-stage aux summed over stages, averaged over microbatches —
+            # same normalization as the DP path (one laux per micro, meaned)
+            laux = jax.lax.psum(lauxsum, topo.PIPE_AXIS) / m
+            loss = loss + cfg.moe_aux_loss_coef * laux
+        return loss
 
     def _build_train_step(self):
         auto_axes = frozenset(a for a in self.mesh.axis_names
