@@ -67,18 +67,23 @@ def initialize(args: Any = None,
     return engine, engine.optimizer, dataloader, engine.lr_schedule
 
 
-def init_inference(model: Any = None, config: Any = None, **kwargs):
+def init_inference(model: Any = None, config: Any = None,
+                   params: Any = None, mesh: Any = None, **kwargs):
     """Build an inference engine. Reference: `deepspeed/__init__.py:233`
-    (merges config dict + kwargs the same way)."""
-    try:
-        from .inference.engine import InferenceEngine
-        from .inference.config import DeepSpeedInferenceConfig
-    except ImportError as e:
-        raise NotImplementedError(
-            "inference engine module not available yet") from e
-    cfg_dict = dict(config) if isinstance(config, dict) else {}
-    cfg_dict.update(kwargs)
-    return InferenceEngine(model, DeepSpeedInferenceConfig(**cfg_dict))
+    (merges config dict + kwargs the same way).
+
+    ``params`` — explicit weights pytree (e.g. from
+    `module_inject.convert_hf_model`); otherwise ``config.checkpoint`` is
+    restored TP-sliced, else fresh weights."""
+    from .inference.engine import InferenceEngine
+    from .inference.config import DeepSpeedInferenceConfig
+    if isinstance(config, DeepSpeedInferenceConfig):
+        cfg = (config.model_copy(update=kwargs) if kwargs else config)
+    else:
+        cfg_dict = dict(config) if isinstance(config, dict) else {}
+        cfg_dict.update(kwargs)
+        cfg = DeepSpeedInferenceConfig(**cfg_dict)
+    return InferenceEngine(model, cfg, params=params, mesh=mesh)
 
 
 def add_config_arguments(parser):

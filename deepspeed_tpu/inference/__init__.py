@@ -1,0 +1,8 @@
+"""Inference: TP-sliced serving engine with compiled decode loop.
+
+Counterpart of `/root/reference/deepspeed/inference/`.
+"""
+from .config import DeepSpeedInferenceConfig
+from .engine import InferenceEngine
+
+__all__ = ["DeepSpeedInferenceConfig", "InferenceEngine"]

@@ -1,0 +1,62 @@
+"""Inference config.
+
+Mirrors the reference ``DeepSpeedInferenceConfig``
+(`/root/reference/deepspeed/inference/config.py`, 276 LoC): dtype,
+tensor_parallel, max_out_tokens, kernel injection, quantization and moe
+blocks — minus the CUDA-graph knob (jit + donated buffers give the same
+replay-without-dispatch behavior for free) and plus TPU mesh controls.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from pydantic import Field
+
+from ..runtime.config_utils import ConfigModel
+
+
+class TensorParallelConfig(ConfigModel):
+    """`inference/config.py` DeepSpeedTPConfig (tp_size there)."""
+    enabled: bool = True
+    tp_size: int = 1
+
+
+class MoEInferenceConfig(ConfigModel):
+    enabled: bool = False
+    ep_size: int = 1
+
+
+class QuantConfig(ConfigModel):
+    """Weight quantization for serving (reference quant block: qkv/mlp int8).
+    ``bits`` 0 disables."""
+    enabled: bool = False
+    bits: int = 8
+
+
+class DeepSpeedInferenceConfig(ConfigModel):
+    dtype: str = "bfloat16"              # serving dtype for weights/compute
+    tensor_parallel: TensorParallelConfig = Field(
+        default_factory=TensorParallelConfig)
+    moe: MoEInferenceConfig = Field(default_factory=MoEInferenceConfig)
+    quant: QuantConfig = Field(default_factory=QuantConfig)
+    # KV workspace sizing (reference inference_context.h: max_out_tokens
+    # bounds the preallocated cache)
+    max_out_tokens: int = 1024
+    max_batch_size: int = 16
+    # kernel injection (reference replace_with_kernel_inject): use the
+    # Pallas decode kernel on the token-at-a-time path
+    replace_with_kernel_inject: bool = True
+    # checkpoint to load params from (a deepspeed_tpu training checkpoint
+    # dir, or None when the caller passes params directly)
+    checkpoint: Optional[str] = None
+    checkpoint_tag: Optional[str] = None
+    # sampling defaults for generate()
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+
+    def compute_dtype(self):
+        import jax.numpy as jnp
+        return {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
+                "float32": jnp.float32, "bf16": jnp.bfloat16,
+                "fp16": jnp.float16, "fp32": jnp.float32}[self.dtype]

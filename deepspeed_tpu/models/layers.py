@@ -118,9 +118,14 @@ def rotary_freqs(head_dim: int, rotary_dim: int, max_seq: int,
     return jnp.cos(freqs), jnp.sin(freqs)
 
 
-def apply_rotary(x, cos, sin, positions=None):
-    """x: [B, T, H, Dh]; rotate first rotary_dim dims (interleaved-pair
-    convention, reference `csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu`).
+def apply_rotary(x, cos, sin, positions=None, interleaved=True):
+    """x: [B, T, H, Dh]; rotate first rotary_dim dims.
+
+    ``interleaved=True`` — GPT-J/RoFormer "rotate_every_two" pairing
+    (dims 2i, 2i+1), the reference's rotate_every_two path in
+    `csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu`.
+    ``interleaved=False`` — GPT-NeoX "rotate_half" pairing (dims i, i+d/2),
+    the convention of the NeoX family and HF GPTNeoX.
     """
     rotary_dim = cos.shape[-1] * 2
     x_rot, x_pass = x[..., :rotary_dim], x[..., rotary_dim:]
@@ -130,10 +135,17 @@ def apply_rotary(x, cos, sin, positions=None):
     else:
         c = cos[positions][:, :, None, :]
         s = sin[positions][:, :, None, :]
-    x1, x2 = x_rot[..., 0::2], x_rot[..., 1::2]
-    y1 = x1 * c - x2 * s
-    y2 = x2 * c + x1 * s
-    y = jnp.stack([y1, y2], axis=-1).reshape(x_rot.shape)
+    if interleaved:
+        x1, x2 = x_rot[..., 0::2], x_rot[..., 1::2]
+        y1 = x1 * c - x2 * s
+        y2 = x2 * c + x1 * s
+        y = jnp.stack([y1, y2], axis=-1).reshape(x_rot.shape)
+    else:
+        half = rotary_dim // 2
+        x1, x2 = x_rot[..., :half], x_rot[..., half:]
+        y1 = x1 * c - x2 * s
+        y2 = x2 * c + x1 * s
+        y = jnp.concatenate([y1, y2], axis=-1)
     return jnp.concatenate([y.astype(x.dtype), x_pass], axis=-1)
 
 

@@ -46,6 +46,10 @@ class TransformerConfig:
     pos_embedding: str = "learned"     # learned | rotary | none
     rotary_pct: float = 1.0
     rotary_base: float = 10000.0
+    # True = GPT-J "rotate_every_two" pairing (the pre-existing default —
+    # checkpoints trained before this knob keep their convention);
+    # False = NeoX-family "rotate_half" (set by neox_config / HF import)
+    rotary_interleaved: bool = True
     parallel_residual: bool = False    # NeoX-style x + attn(ln1 x) + mlp(ln2 x)
     norm_type: str = "layernorm"       # layernorm | rmsnorm
     activation: str = "gelu"
@@ -143,8 +147,12 @@ def gpt2_config(size: str = "125m", **kw) -> TransformerConfig:
 
 
 def neox_config(size: str = "1.3b", **kw) -> TransformerConfig:
+    # rotate_half is the convention the real GPT-NeoX family uses
+    # (architecture-fidelity fix; breaks rotary checkpoints from before the
+    # rotary_interleaved knob existed)
     return TransformerConfig(**{"pos_embedding": "rotary",
                                 "parallel_residual": True,
+                                "rotary_interleaved": False,
                                 **NEOX_SIZES[size], **kw})
 
 
@@ -258,8 +266,10 @@ class TransformerLM:
         if c.pos_embedding == "rotary":
             cos = self._cos.astype(jnp.float32)
             sin = self._sin.astype(jnp.float32)
-            q = L.apply_rotary(q, cos, sin, positions)
-            k = L.apply_rotary(k, cos, sin, positions)
+            q = L.apply_rotary(q, cos, sin, positions,
+                               interleaved=c.rotary_interleaved)
+            k = L.apply_rotary(k, cos, sin, positions,
+                               interleaved=c.rotary_interleaved)
         new_cache = None
         offset = 0
         if cache_kv is None and c.attn_impl == "flash":
@@ -279,6 +289,16 @@ class TransformerLM:
             offset = idx
             new_cache = (ck, cv)
             tk = ck.shape[1]
+            if t == 1 and c.attn_impl == "flash":
+                # token-at-a-time hot path → fused Pallas decode kernel
+                # (reference softmax_context, csrc/.../softmax.cu)
+                from ..ops.transformer import decode_attention as DA
+                if DA.supports(hd, tk):
+                    o = DA.decode_attention(
+                        q[:, 0], k.astype(q.dtype), v.astype(q.dtype),
+                        idx + 1)[:, None]
+                    o = o.reshape(b, t, nh * hd)
+                    return L.dense_apply(p["out"], o), new_cache
             valid = jnp.arange(tk)[None, None, None, :] < (idx + t)
             o = L.causal_attention(q, k.astype(q.dtype), v.astype(q.dtype),
                                    mask=valid, kv_positions_offset=offset)

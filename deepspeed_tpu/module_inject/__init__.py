@@ -1,0 +1,14 @@
+"""Module injection: HF-checkpoint policies + auto-TP.
+
+Counterpart of `/root/reference/deepspeed/module_inject/` — the reference
+swaps nn.Modules for kernel-injected replicas with sliced weights; here
+policies convert foreign checkpoints into the native params pytree and TP
+slicing is a sharding declaration (`auto_tp_specs`) applied at device_put.
+"""
+from .auto_tp import auto_tp_specs
+from .policies import (POLICIES, convert_hf_model, hf_gpt2_config,
+                       hf_neox_config, load_hf_gpt2, load_hf_neox)
+
+__all__ = ["auto_tp_specs", "POLICIES", "convert_hf_model",
+           "hf_gpt2_config", "hf_neox_config", "load_hf_gpt2",
+           "load_hf_neox"]

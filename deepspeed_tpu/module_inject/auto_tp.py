@@ -1,0 +1,38 @@
+"""Auto tensor-parallelism for models without partition specs.
+
+Role-equivalent of the reference's AutoTP heuristic
+(`/root/reference/deepspeed/module_inject/auto_tp.py`, 92 LoC), which walks
+the module tree looking for linear layers to slice and all-reduce points.
+Declarative redesign: given only the params pytree (shapes), derive a
+PartitionSpec tree that shards each weight's largest divisible dim over the
+``model`` axis; GSPMD then places the all-reduces the reference has to
+discover by graph analysis. Biases/scalars replicate (sharded-bias handling
+is exactly the class of bug the reference's heuristic has to special-case).
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.topology import MODEL_AXIS
+
+
+def auto_tp_specs(param_shapes, mesh: Mesh, min_size: int = 1024):
+    """Shapes pytree → PartitionSpec pytree (TP over ``model``).
+
+    Leaves with fewer than 2 dims, smaller than ``min_size`` elements, or
+    with no dim divisible by the axis size stay replicated."""
+    tp = mesh.shape.get(MODEL_AXIS, 1)
+
+    def spec(leaf) -> P:
+        shape = tuple(getattr(leaf, "shape", ()))
+        entries = [None] * len(shape)
+        if tp > 1 and len(shape) >= 2 and int(np.prod(shape)) >= min_size:
+            divisible = [d for d, s in enumerate(shape) if s % tp == 0]
+            if divisible:
+                best = max(divisible, key=lambda d: shape[d])
+                entries[best] = MODEL_AXIS
+        return P(*entries)
+
+    return jax.tree_util.tree_map(spec, param_shapes)

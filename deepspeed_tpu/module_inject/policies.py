@@ -1,0 +1,193 @@
+"""Weight-injection policies: HuggingFace checkpoints → TransformerLM params.
+
+Role-equivalent of the reference's per-architecture policies + containers
+(`/root/reference/deepspeed/module_inject/policy.py`,
+`module_inject/containers/gpt2.py`, `containers/gptneox.py`, registry at
+`replace_policy.py:17`): each policy knows the source model's weight-name map
+and emits our stacked-scan params pytree. Where the reference swaps nn.Modules
+for fused-kernel modules holding sliced tensors, here conversion is pure data
+movement — the TP slicing happens afterwards when the tree is device_put into
+the mesh shardings (`inference/engine.py`), so policies stay layout-free.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ..models.transformer import TransformerConfig
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor / array-like → numpy (host)."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def _stack(sd: Dict[str, Any], fmt: str, n: int, **kw) -> np.ndarray:
+    return np.stack([_np(sd[fmt.format(i=i, **kw)]) for i in range(n)])
+
+
+def _map_act(hf_act: str) -> str:
+    """HF activation name → ACT_FNS key. HF 'gelu' is the EXACT erf GeLU;
+    'gelu_new'/'gelu_fast'/'gelu_pytorch_tanh' are tanh approximations."""
+    table = {"gelu": "gelu_exact", "gelu_new": "gelu", "gelu_fast": "gelu",
+             "gelu_pytorch_tanh": "gelu", "relu": "relu", "silu": "silu"}
+    if hf_act not in table:
+        raise ValueError(f"Unsupported HF activation {hf_act!r}")
+    return table[hf_act]
+
+
+def hf_gpt2_config(hf_cfg, **overrides) -> TransformerConfig:
+    """transformers.GPT2Config → TransformerConfig."""
+    return TransformerConfig(
+        vocab_size=hf_cfg.vocab_size,
+        max_seq_len=hf_cfg.n_positions,
+        num_layers=hf_cfg.n_layer,
+        num_heads=hf_cfg.n_head,
+        d_model=hf_cfg.n_embd,
+        pos_embedding="learned",
+        parallel_residual=False,
+        norm_type="layernorm",
+        activation=_map_act(hf_cfg.activation_function),
+        use_bias=True,
+        tie_embeddings=True,
+        layernorm_eps=hf_cfg.layer_norm_epsilon,
+        **overrides)
+
+
+def load_hf_gpt2(state_dict: Dict[str, Any],
+                 config: TransformerConfig) -> Dict:
+    """HF GPT-2 state dict (transformer.* naming; Conv1D weights already
+    [in, out]) → params pytree. QKV layout matches: c_attn concatenates
+    [q|k|v] on the output dim, exactly our qkv reshape order."""
+    sd = {k.replace("transformer.", ""): v for k, v in state_dict.items()}
+    n = config.num_layers
+
+    def blk(name):
+        return _stack(sd, "h.{i}." + name, n)
+
+    params = {
+        "embed": {"embedding": _np(sd["wte.weight"])},
+        "pos_embed": {"embedding": _np(sd["wpe.weight"])},
+        "blocks": {
+            "ln1": {"scale": blk("ln_1.weight"), "bias": blk("ln_1.bias")},
+            "attn": {
+                "qkv": {"kernel": blk("attn.c_attn.weight"),
+                        "bias": blk("attn.c_attn.bias")},
+                "out": {"kernel": blk("attn.c_proj.weight"),
+                        "bias": blk("attn.c_proj.bias")},
+            },
+            "ln2": {"scale": blk("ln_2.weight"), "bias": blk("ln_2.bias")},
+            "mlp": {
+                "fc_in": {"kernel": blk("mlp.c_fc.weight"),
+                          "bias": blk("mlp.c_fc.bias")},
+                "fc_out": {"kernel": blk("mlp.c_proj.weight"),
+                           "bias": blk("mlp.c_proj.bias")},
+            },
+        },
+        "ln_f": {"scale": _np(sd["ln_f.weight"]),
+                 "bias": _np(sd["ln_f.bias"])},
+    }
+    return params
+
+
+def hf_neox_config(hf_cfg, **overrides) -> TransformerConfig:
+    """transformers.GPTNeoXConfig → TransformerConfig."""
+    return TransformerConfig(
+        vocab_size=hf_cfg.vocab_size,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        d_model=hf_cfg.hidden_size,
+        d_ff=hf_cfg.intermediate_size,
+        pos_embedding="rotary",
+        rotary_pct=hf_cfg.rotary_pct,
+        rotary_base=getattr(hf_cfg, "rotary_emb_base", 10000.0),
+        rotary_interleaved=False,     # HF GPTNeoX uses rotate_half
+        parallel_residual=hf_cfg.use_parallel_residual,
+        norm_type="layernorm",
+        activation=_map_act(hf_cfg.hidden_act),
+        use_bias=True,
+        tie_embeddings=False,
+        layernorm_eps=hf_cfg.layer_norm_eps,
+        **overrides)
+
+
+def load_hf_neox(state_dict: Dict[str, Any],
+                 config: TransformerConfig) -> Dict:
+    """HF GPT-NeoX state dict → params pytree.
+
+    Two layout conversions (reference container: `containers/gptneox.py`):
+    torch Linear weights are [out, in] → transposed; NeoX fuses QKV
+    per-head as [h, 3, d] on the output dim → regrouped to our [3, h, d]."""
+    sd = {k.replace("gpt_neox.", ""): v for k, v in state_dict.items()}
+    n, nh = config.num_layers, config.num_heads
+    d, hd = config.d_model, config.hdim
+
+    def blk_t(name):   # linear kernels: [out, in] -> [in, out], stacked
+        return np.stack([
+            _np(sd[f"layers.{i}.{name}.weight"]).T for i in range(n)])
+
+    def blk_b(name):
+        return _stack(sd, "layers.{i}." + name + ".bias", n)
+
+    def blk_ln(name, leaf):
+        return _stack(sd, "layers.{i}." + name + "." + leaf, n)
+
+    qkv_w = np.stack([_np(sd[f"layers.{i}.attention.query_key_value.weight"])
+                      for i in range(n)])            # [L, 3*D, D] torch [out,in]
+    qkv_w = (qkv_w.reshape(n, nh, 3, hd, d)          # out dim = [h, 3, hd]
+             .transpose(0, 4, 2, 1, 3)               # [L, D, 3, h, hd]
+             .reshape(n, d, 3 * nh * hd))
+    qkv_b = np.stack([_np(sd[f"layers.{i}.attention.query_key_value.bias"])
+                      for i in range(n)])
+    qkv_b = (qkv_b.reshape(n, nh, 3, hd).transpose(0, 2, 1, 3)
+             .reshape(n, 3 * nh * hd))
+
+    params = {
+        "embed": {"embedding": _np(sd["embed_in.weight"])},
+        "blocks": {
+            "ln1": {"scale": blk_ln("input_layernorm", "weight"),
+                    "bias": blk_ln("input_layernorm", "bias")},
+            "attn": {
+                "qkv": {"kernel": qkv_w, "bias": qkv_b},
+                "out": {"kernel": blk_t("attention.dense"),
+                        "bias": blk_b("attention.dense")},
+            },
+            "ln2": {"scale": blk_ln("post_attention_layernorm", "weight"),
+                    "bias": blk_ln("post_attention_layernorm", "bias")},
+            "mlp": {
+                "fc_in": {"kernel": blk_t("mlp.dense_h_to_4h"),
+                          "bias": blk_b("mlp.dense_h_to_4h")},
+                "fc_out": {"kernel": blk_t("mlp.dense_4h_to_h"),
+                           "bias": blk_b("mlp.dense_4h_to_h")},
+            },
+        },
+        "ln_f": {"scale": _np(sd["final_layer_norm.weight"]),
+                 "bias": _np(sd["final_layer_norm.bias"])},
+        "lm_head": {"kernel": _np(state_dict["embed_out.weight"]).T},
+    }
+    return params
+
+
+# registry (reference replace_policy.py:17)
+POLICIES = {
+    "gpt2": (hf_gpt2_config, load_hf_gpt2),
+    "gpt_neox": (hf_neox_config, load_hf_neox),
+}
+
+
+def convert_hf_model(hf_model, **config_overrides):
+    """(transformers PreTrainedModel) → (TransformerConfig, params).
+
+    Policy selected from ``model_type`` like the reference's registry walk
+    (`replace_module.py:306`)."""
+    mtype = hf_model.config.model_type
+    if mtype not in POLICIES:
+        raise ValueError(
+            f"No policy for model_type={mtype!r}; have {list(POLICIES)}")
+    cfg_fn, load_fn = POLICIES[mtype]
+    cfg = cfg_fn(hf_model.config, **config_overrides)
+    return cfg, load_fn(hf_model.state_dict(), cfg)

@@ -1,0 +1,93 @@
+"""Decode attention — Pallas TPU kernel for the token-at-a-time path.
+
+Role-equivalent of the reference's fused ``softmax_context`` inference kernel
+(`/root/reference/csrc/transformer/inference/csrc/softmax.cu:1` +
+``attention_unfused`` dispatch in `pt_binding.cpp`): one query token attends
+over the KV cache with a validity mask, softmax fused in-kernel.
+
+TPU design: one grid step per (batch, head). The whole KV slice for that
+head lives in VMEM (S·D ≤ a few MB for any practical cache), so no online
+softmax is needed — a single masked softmax over the cache axis. The valid
+length arrives as a scalar-prefetch operand (SMEM), so one compiled kernel
+serves every decode position.
+
+Layout contract: q [B, H, D] (the single new token), k/v [B, S, H, D]
+(the cache); returns [B, H, D].
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale):
+    # q_ref [1, D]; k_ref/v_ref [S, D]; len_ref SMEM [1]
+    q = q_ref[...].astype(jnp.float32)            # [1, D]
+    k = k_ref[...].astype(jnp.float32)            # [S, D]
+    s = k.shape[0]
+    scores = jnp.dot(k, q.T,
+                     preferred_element_type=jnp.float32) * sm_scale  # [S, 1]
+    pos = jax.lax.broadcasted_iota(jnp.int32, (s, 1), 0)
+    scores = jnp.where(pos < len_ref[0], scores, MASK_VALUE)
+    m = jnp.max(scores, axis=0, keepdims=True)
+    p = jnp.exp(scores - m)
+    denom = jnp.sum(p, axis=0, keepdims=True)
+    v = v_ref[...].astype(jnp.float32)            # [S, D]
+    out = jnp.dot(p.T, v, preferred_element_type=jnp.float32) / denom  # [1,D]
+    o_ref[...] = out.astype(o_ref.dtype)
+
+
+def decode_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                     length: jnp.ndarray,
+                     sm_scale: Optional[float] = None,
+                     interpret: Optional[bool] = None) -> jnp.ndarray:
+    """q [B, H, D], k/v [B, S, H, D], length: int32 scalar (valid cache
+    prefix, i.e. index of the new token + 1). Returns [B, H, D]."""
+    b, h, d = q.shape
+    s = k.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = _interpret_default()
+
+    qf = q.reshape(b * h, 1, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    length = jnp.asarray(length, jnp.int32).reshape(1)
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, sm_scale=sm_scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * h,),
+            in_specs=[
+                pl.BlockSpec((None, 1, d), lambda i, *_: (i, 0, 0)),
+                pl.BlockSpec((None, s, d), lambda i, *_: (i, 0, 0)),
+                pl.BlockSpec((None, s, d), lambda i, *_: (i, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((None, 1, d), lambda i, *_: (i, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), q.dtype),
+        interpret=interpret,
+    )(length, qf, kf, vf)
+    return out.reshape(b, h, d)
+
+
+def supports(head_dim: int, cache_len: int) -> bool:
+    """Kernel constraints: lane-aligned head dim keeps the MXU fed; the
+    per-head K AND V blocks (plus their fp32 in-kernel copies) must fit
+    VMEM (~16 MB/core) — budget 2 buffers x 2 copies x 4 bytes ≤ 6 MB."""
+    return head_dim % 8 == 0 and cache_len * head_dim * 16 <= 6 * 2 ** 20
