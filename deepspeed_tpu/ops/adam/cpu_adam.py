@@ -1,0 +1,170 @@
+"""Host CPU Adam/Adagrad for ZeRO-Offload.
+
+Role-equivalent of the reference ``DeepSpeedCPUAdam``
+(`/root/reference/deepspeed/ops/adam/cpu_adam.py:12` over
+`csrc/adam/cpu_adam.cpp`) and ``DeepSpeedCPUAdagrad``: optimizer state as
+host numpy arrays, stepped by the native library (`ops/csrc/cpu_adam.cpp`),
+with a pure-numpy fallback when the toolchain is unavailable. Each step
+also emits the bf16 device copy in the same sweep.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..op_builder import BuildError, build_and_load
+from ...utils.logging import logger
+
+_C_F32 = ctypes.POINTER(ctypes.c_float)
+_C_U16 = ctypes.POINTER(ctypes.c_uint16)
+
+
+def _lib():
+    lib = build_and_load("cpu_adam")
+    lib.ds_adam_step.argtypes = [
+        ctypes.c_int64, _C_F32, _C_F32, _C_F32, _C_F32,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        ctypes.c_float, ctypes.c_int, ctypes.c_float, ctypes.c_int, _C_U16]
+    lib.ds_adagrad_step.argtypes = [
+        ctypes.c_int64, _C_F32, _C_F32, _C_F32,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        _C_U16]
+    lib.ds_f32_to_bf16.argtypes = [ctypes.c_int64, _C_F32, _C_U16]
+    return lib
+
+
+def _ptr(a: np.ndarray, typ):
+    return a.ctypes.data_as(typ)
+
+
+class DeepSpeedCPUAdam:
+    """Flat-leaf host Adam. ``leaves`` — list of fp32 numpy arrays (master
+    params), stepped in place; moments allocated here."""
+
+    def __init__(self, leaves: List[np.ndarray], lr: float = 1e-3,
+                 betas: Tuple[float, float] = (0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 adamw_mode: bool = True):
+        # always a fresh writable buffer: jax.device_get hands back
+        # read-only arrays and ascontiguousarray would alias them
+        self.master: List[np.ndarray] = [
+            np.array(l, dtype=np.float32, order="C") for l in leaves]
+        self.m = [np.zeros_like(l) for l in self.master]
+        self.v = [np.zeros_like(l) for l in self.master]
+        self.lr, self.betas, self.eps = lr, betas, eps
+        self.weight_decay, self.adamw_mode = weight_decay, adamw_mode
+        self.step_count = 0
+        try:
+            self._lib = _lib()
+        except BuildError as e:
+            logger.warning(f"native cpu_adam unavailable ({e}); "
+                           f"falling back to numpy (slower)")
+            self._lib = None
+
+    def step(self, grads: List[np.ndarray], lr: Optional[float] = None,
+             grad_scale: float = 1.0,
+             out_bf16: Optional[List[np.ndarray]] = None) -> None:
+        """In-place update of every leaf. ``grad_scale`` divides the grads
+        (loss-scale x microbatch x clip, folded into the sweep);
+        ``out_bf16`` — optional preallocated uint16 buffers receiving the
+        bf16 copies of the updated params."""
+        lr = self.lr if lr is None else float(lr)
+        self.step_count += 1
+        b1, b2 = self.betas
+        for i, g in enumerate(grads):
+            p, m, v = self.master[i], self.m[i], self.v[i]
+            ob = out_bf16[i] if out_bf16 is not None else None
+            if self._lib is not None:
+                g = np.ascontiguousarray(g, dtype=np.float32)
+                self._lib.ds_adam_step(
+                    p.size, _ptr(p, _C_F32), _ptr(m, _C_F32),
+                    _ptr(v, _C_F32), _ptr(g, _C_F32),
+                    lr, b1, b2, self.eps, self.weight_decay,
+                    self.step_count, grad_scale, int(self.adamw_mode),
+                    _ptr(ob, _C_U16) if ob is not None else _C_U16())
+            else:
+                gf = g.astype(np.float32) / grad_scale
+                if not self.adamw_mode and self.weight_decay:
+                    gf = gf + self.weight_decay * p
+                m *= b1
+                m += (1 - b1) * gf
+                v *= b2
+                v += (1 - b2) * gf * gf
+                c1 = 1 - b1 ** self.step_count
+                c2 = 1 - b2 ** self.step_count
+                u = (m / c1) / (np.sqrt(v / c2) + self.eps)
+                if self.adamw_mode and self.weight_decay:
+                    u = u + self.weight_decay * p
+                p -= lr * u
+                if ob is not None:
+                    ob[:] = f32_to_bf16_numpy(p)
+
+    def state_arrays(self) -> Dict[str, List[np.ndarray]]:
+        return {"master": self.master, "m": self.m, "v": self.v}
+
+    def load_state_arrays(self, state: Dict[str, List[np.ndarray]],
+                          step_count: int) -> None:
+        for name in ("master", "m", "v"):
+            dst = getattr(self, {"master": "master", "m": "m",
+                                 "v": "v"}[name])
+            for d, s in zip(dst, state[name]):
+                np.copyto(d, np.asarray(s, dtype=np.float32))
+        self.step_count = step_count
+
+
+class DeepSpeedCPUAdagrad:
+    """Host Adagrad (reference csrc/adagrad/cpu_adagrad.cpp)."""
+
+    def __init__(self, leaves: List[np.ndarray], lr: float = 1e-2,
+                 eps: float = 1e-10, weight_decay: float = 0.0):
+        self.master = [np.array(l, dtype=np.float32, order="C")
+                       for l in leaves]
+        self.sq = [np.zeros_like(l) for l in self.master]
+        self.lr, self.eps, self.weight_decay = lr, eps, weight_decay
+        self.step_count = 0
+        try:
+            self._lib = _lib()
+        except BuildError:
+            self._lib = None
+
+    def step(self, grads, lr=None, grad_scale: float = 1.0,
+             out_bf16=None) -> None:
+        lr = self.lr if lr is None else float(lr)
+        self.step_count += 1
+        for i, g in enumerate(grads):
+            p, sq = self.master[i], self.sq[i]
+            ob = out_bf16[i] if out_bf16 is not None else None
+            if self._lib is not None:
+                g = np.ascontiguousarray(g, dtype=np.float32)
+                self._lib.ds_adagrad_step(
+                    p.size, _ptr(p, _C_F32), _ptr(sq, _C_F32),
+                    _ptr(g, _C_F32), lr, self.eps, self.weight_decay,
+                    grad_scale,
+                    _ptr(ob, _C_U16) if ob is not None else _C_U16())
+            else:
+                gf = g.astype(np.float32) / grad_scale
+                if self.weight_decay:
+                    gf = gf + self.weight_decay * p
+                sq += gf * gf
+                p -= lr * gf / (np.sqrt(sq) + self.eps)
+                if ob is not None:
+                    ob[:] = f32_to_bf16_numpy(p)
+
+    def state_arrays(self):
+        return {"master": self.master, "sq": self.sq}
+
+    def load_state_arrays(self, state, step_count):
+        for d, s in zip(self.master, state["master"]):
+            np.copyto(d, np.asarray(s, dtype=np.float32))
+        for d, s in zip(self.sq, state["sq"]):
+            np.copyto(d, np.asarray(s, dtype=np.float32))
+        self.step_count = step_count
+
+
+def f32_to_bf16_numpy(a: np.ndarray) -> np.ndarray:
+    """Round-to-nearest-even fp32 → bf16 bits (numpy fallback path)."""
+    x = a.astype(np.float32).view(np.uint32)
+    lsb = (x >> 16) & 1
+    return ((x + 0x7FFF + lsb) >> 16).astype(np.uint16)

@@ -1,0 +1,70 @@
+"""JIT build + load of the native host libraries.
+
+Role-equivalent of the reference op_builder
+(`/root/reference/op_builder/builder.py:112` OpBuilder, `jit_load` :487):
+compile csrc into a shared object on first use, cache by source hash, load
+via ctypes (pybind11 is not in this environment; the C ABI is the binding).
+Pallas kernels need no builder — only host-side C++ goes through here.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import Optional
+
+from ..utils.logging import logger
+
+_CSRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_build")
+_LOADED: dict = {}
+
+
+class BuildError(RuntimeError):
+    pass
+
+
+def _source_hash(path: str) -> str:
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
+def build_and_load(name: str, extra_flags: Optional[list] = None,
+                   verbose: bool = False) -> ctypes.CDLL:
+    """Compile ``csrc/<name>.cpp`` → cached .so → ctypes handle."""
+    if name in _LOADED:
+        return _LOADED[name]
+    src = os.path.join(_CSRC, f"{name}.cpp")
+    if not os.path.exists(src):
+        raise BuildError(f"no such source: {src}")
+    tag = _source_hash(src)
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    so_path = os.path.join(_BUILD_DIR, f"{name}-{tag}.so")
+    if not os.path.exists(so_path):
+        flags = ["-O3", "-shared", "-fPIC", "-fopenmp", "-march=native",
+                 "-funroll-loops", "-std=c++17"]
+        cmd = ["g++", *flags, *(extra_flags or []), src, "-o",
+               so_path + ".tmp"]
+        try:
+            subprocess.run(cmd, check=True, capture_output=not verbose,
+                           text=True)
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            raise BuildError(f"building {name} failed: {detail}") from e
+        os.replace(so_path + ".tmp", so_path)  # atomic: no torn .so on race
+        logger.info(f"built native op {name} -> {so_path}")
+    lib = ctypes.CDLL(so_path)
+    _LOADED[name] = lib
+    return lib
+
+
+def is_compatible(name: str) -> bool:
+    """Capability probe (reference OpBuilder.is_compatible, builder.py:236):
+    can this host build + load the op right now?"""
+    try:
+        build_and_load(name)
+        return True
+    except BuildError:
+        return False

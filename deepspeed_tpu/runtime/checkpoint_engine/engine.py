@@ -74,6 +74,23 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         state["scaler"] = dict(scaler._asdict())
     ckptr.save(os.path.join(path, "state"), state, force=True)
 
+    if getattr(engine, "_host_opt", None) is not None:
+        # ZeRO-Offload host state (masters + moments, numpy) — saved
+        # synchronously beside the device tree (reference writes these into
+        # the per-rank zero checkpoint files, engine.py:3398)
+        import orbax.checkpoint as ocp
+        host_sd = engine._host_opt.state_dict()
+        host_tree = {"arrays": host_sd["arrays"],
+                     "step_count": np.int64(host_sd["step_count"])}
+        if engine._host_scaler is not None:
+            s = engine._host_scaler
+            host_tree["scaler"] = {
+                "scale": np.float64(s.scale),
+                "good_steps": np.int64(s.good_steps),
+                "hysteresis": np.int64(s.hysteresis)}
+        ocp.Checkpointer(ocp.StandardCheckpointHandler()).save(
+            os.path.join(path, "host_opt"), host_tree, force=True)
+
     meta = {
         "tag": tag,
         "global_steps": engine.global_steps,
@@ -202,6 +219,31 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             restored.pop("scaler")
         engine.state = restored
 
+    host_path = os.path.join(path, "host_opt")
+    if getattr(engine, "_host_opt", None) is not None:
+        want_opt = load_optimizer_states and not load_module_only
+        if want_opt:
+            if not os.path.isdir(host_path):
+                raise FileNotFoundError(
+                    f"engine runs with optimizer offload but {host_path} is "
+                    f"missing — checkpoint was saved without offload (load "
+                    f"with load_module_only=True to take params only)")
+            restored_host = ocp.Checkpointer(
+                ocp.StandardCheckpointHandler()).restore(host_path)
+            engine._host_opt.load_state_dict(
+                {"arrays": restored_host["arrays"],
+                 "step_count": restored_host["step_count"]})
+            if engine._host_scaler is not None and "scaler" in restored_host:
+                s = restored_host["scaler"]
+                engine._host_scaler.scale = float(s["scale"])
+                engine._host_scaler.good_steps = int(s["good_steps"])
+                engine._host_scaler.hysteresis = int(s["hysteresis"])
+        else:
+            # params-only load: masters re-derived from the restored device
+            # params (fresh moments) — otherwise step 1 would blend new
+            # params with stale masters
+            engine._host_opt.reset_from_params(engine.state["params"])
+
     engine.global_steps = meta.get("global_steps", 0)
     engine.micro_steps = meta.get("micro_steps", 0)
     # skipped_steps lives in state["skipped"], restored with the tree
@@ -221,6 +263,22 @@ def get_fp32_state_dict_from_zero_checkpoint(load_dir: str,
     path = _tag_path(load_dir, tag)
     ckptr = _checkpointer()
     restored = ckptr.restore(os.path.join(path, "state"))
-    params = restored["params"]
-    return jax.tree_util.tree_map(
-        lambda x: np.asarray(x, dtype=np.float32), params)
+    params = jax.tree_util.tree_map(
+        lambda x: np.asarray(x, dtype=np.float32), restored["params"])
+    host_path = os.path.join(path, "host_opt")
+    if os.path.isdir(host_path):
+        # offload checkpoint: the TRUE fp32 masters live host-side; the
+        # device tree's params are bf16-rounded copies
+        import orbax.checkpoint as ocp
+        host = ocp.Checkpointer(
+            ocp.StandardCheckpointHandler()).restore(host_path)
+        masters = host["arrays"]["master"]
+        if isinstance(masters, dict):   # orbax may key list items "0".."N"
+            masters = [masters[k] for k in
+                       sorted(masters, key=lambda s: int(s))]
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        assert len(leaves) == len(masters), \
+            f"{len(leaves)} param leaves vs {len(masters)} masters"
+        params = jax.tree_util.tree_unflatten(
+            treedef, [np.asarray(m, dtype=np.float32) for m in masters])
+    return params

@@ -124,7 +124,10 @@ class DeepSpeedEngine:
                 lambda s: P(*([None] * len(s.shape))), self._param_shapes)
         self.zero_policy = ZeroShardingPolicy(
             self.zero_stage, self.mesh, param_specs, self._param_shapes,
-            min_partition_size=0)
+            min_partition_size=0,
+            param_persistence_threshold=(
+                self._config.zero_config.param_persistence_threshold
+                if self.zero_stage >= 3 else 0))
         self.master_specs = self.zero_policy.master_param_specs()
         self.grad_specs = self.zero_policy.grad_specs()
         opt_shapes = jax.eval_shape(self.optimizer.init, self._param_shapes)
@@ -141,6 +144,17 @@ class DeepSpeedEngine:
         self.global_steps = 0
         self.micro_steps = 0
         self._step_times: list = []
+
+        # -- ZeRO-Offload tier 1 (host DRAM optimizer) ---------------------
+        from .zero.offload import validate_offload_config
+        self.offload_enabled = validate_offload_config(self._config)
+        self._host_opt = None
+        self._host_scaler = None
+        if self.offload_enabled and optimizer is not None:
+            raise ValueError(
+                "offload_optimizer needs a config-named optimizer "
+                "(Adam/AdamW/Adagrad) — the host step runs in native code, "
+                "not through a user optimizer object")
 
         # -- state init (sharded at materialization) -----------------------
         if not dont_init:
@@ -188,6 +202,11 @@ class DeepSpeedEngine:
     # state
     # ------------------------------------------------------------------
     def state_specs(self) -> Dict:
+        if self.offload_enabled:
+            # device state is ONLY compute-dtype params — masters/moments
+            # live on the host (runtime/zero/offload.py)
+            return {"step": P(), "skipped": P(),
+                    "params": self.zero_policy.model_param_specs()}
         specs = {"step": P(), "skipped": P(), "params": self.master_specs,
                  "opt": self.opt_specs}
         if self.loss_scaler is not None:
@@ -203,6 +222,9 @@ class DeepSpeedEngine:
         jitted init materializes only each device's shard (replaces the
         reference's init-then-broadcast `engine.py:1083` and zero.Init
         partition-at-construction `partition_parameters.py:539`)."""
+        if self.offload_enabled:
+            return self._init_state_offload(rng)
+
         def _init(rng):
             params = self.model.init(rng)
             if not self._config.bf16.master_weights and self.bf16_enabled:
@@ -218,6 +240,120 @@ class DeepSpeedEngine:
         with self.mesh:
             return jax.jit(_init,
                            out_shardings=self.state_shardings())(rng)
+
+    def _init_state_offload(self, rng) -> Dict:
+        """Offload init: fp32 params materialize sharded on device, move to
+        host (masters for the CPU optimizer), device keeps the compute-dtype
+        copy in the model shardings."""
+        from .zero.offload import HostLossScaler, ZeroOffloadHostOptimizer
+        f32_shardings = to_named(self.mesh, self.master_specs)
+        with self.mesh:
+            f32_params = jax.jit(self.model.init,
+                                 out_shardings=f32_shardings)(rng)
+        host_tree = jax.device_get(f32_params)
+        self._host_opt = ZeroOffloadHostOptimizer(self, host_tree)
+        if self.loss_scaler is not None:
+            self._host_scaler = HostLossScaler(self.loss_scaler)
+        logger.info(
+            f"ZeRO-Offload: {self._host_opt.host_bytes / 2**30:.2f} GiB "
+            f"optimizer state in host DRAM; device holds "
+            f"{'bf16' if self.compute_dtype == jnp.bfloat16 else str(self.compute_dtype)} params only")
+        param_shardings = to_named(self.mesh,
+                                   self.zero_policy.model_param_specs())
+        # cached for the per-step upload (constant for the engine lifetime)
+        self._offload_shardings = jax.tree_util.tree_leaves(
+            param_shardings, is_leaf=lambda x: isinstance(x, NamedSharding))
+        cast = jax.jit(self._cast_for_compute, out_shardings=param_shardings)
+        with self.mesh:
+            dev_params = cast(f32_params)
+        return {"step": jnp.zeros((), jnp.int32),
+                "skipped": jnp.zeros((), jnp.int32), "params": dev_params}
+
+    def _accumulate_micro_grads(self, state, batch, scale):
+        """Shared GAS loop: scan the microbatch axis, sum f32 grads +
+        scaled losses. Single source of the accumulation semantics for the
+        fused train step AND the offload grad function."""
+        gas = self.gradient_accumulation_steps
+
+        def micro(carry, mb):
+            gsum, lsum = carry
+            loss, grads = jax.value_and_grad(self._micro_loss)(
+                state["params"], mb, scale)
+            grads = constrain(
+                jax.tree_util.tree_map(lambda g: g.astype(jnp.float32),
+                                       grads),
+                self.mesh, self.grad_specs)
+            gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+            return (gsum, lsum + loss), None
+
+        zeros = _tree_zeros_f32(state["params"])
+        if gas == 1:
+            sq = jax.tree_util.tree_map(lambda x: x[0], batch)
+            (gsum, lsum), _ = micro((zeros, jnp.zeros((), jnp.float32)), sq)
+        else:
+            (gsum, lsum), _ = jax.lax.scan(
+                micro, (zeros, jnp.zeros((), jnp.float32)), batch)
+        return gsum, lsum
+
+    def _build_offload_grad_fn(self):
+        def grad_fn(state, batch, scale):
+            gsum, lsum = self._accumulate_micro_grads(state, batch, scale)
+            return lsum, gsum, global_norm(gsum)
+
+        with self.mesh:
+            self._offload_grad_fn = jax.jit(grad_fn)
+        return self._offload_grad_fn
+
+    def _offload_train_step(self, batch: Dict) -> Dict:
+        """grads on device → host C++ optimizer sweep → params back.
+        Reference: the cpu_offload step path of stage_1_and_2.py (grads to
+        pinned host buffers, DeepSpeedCPUAdam.step, param copy-back)."""
+        cfg = self._config
+        if getattr(self, "_offload_grad_fn", None) is None:
+            self._build_offload_grad_fn()
+        gas = self.gradient_accumulation_steps
+        scale = self._host_scaler.scale if self._host_scaler else 1.0
+        lsum, grads, gnorm_raw = self._offload_grad_fn(
+            self.state, batch, jnp.asarray(scale, jnp.float32))
+
+        denom = scale * gas
+        gnorm = float(gnorm_raw) / denom
+        overflow = (not np.isfinite(gnorm)) and \
+            (self._host_scaler is not None
+             and self._host_scaler.detect_overflow)
+        step_i = int(self.state["step"])
+        if overflow:
+            self.state["skipped"] = self.state["skipped"] + 1
+        else:
+            factor = 1.0
+            if cfg.gradient_clipping and cfg.gradient_clipping > 0 \
+                    and np.isfinite(gnorm):
+                factor = min(1.0, cfg.gradient_clipping / max(gnorm, 1e-6))
+            lr = float(self.lr_schedule(jnp.asarray(step_i)))
+            grad_leaves = [np.asarray(x) for x in
+                           jax.tree_util.tree_leaves(jax.device_get(grads))]
+            uploads = self._host_opt.step(
+                grad_leaves, lr=lr, grad_scale=denom / factor,
+                emit_bf16=(self.compute_dtype == jnp.bfloat16))
+            if self.compute_dtype == jnp.float16:
+                uploads = [u.astype(np.float16) for u in uploads]
+            new_leaves = [jax.device_put(u, s)
+                          for u, s in zip(uploads, self._offload_shardings)]
+            self.state["params"] = jax.tree_util.tree_unflatten(
+                self._host_opt.treedef, new_leaves)
+            self.state["step"] = self.state["step"] + 1
+        if self._host_scaler is not None:
+            self._host_scaler.update(overflow)
+
+        metrics = {
+            "loss": float(lsum) / denom,
+            "grad_norm": gnorm,
+            "lr": float(self.lr_schedule(jnp.asarray(step_i))),
+            "overflow": int(overflow),
+            "loss_scale": scale,
+        }
+        self._last_metrics = metrics
+        return metrics
 
     # ------------------------------------------------------------------
     # core step math (shared by fused train_step and compat step())
@@ -307,27 +443,7 @@ class DeepSpeedEngine:
 
         def step_fn(state, batch):
             scale = self._current_scale(state)
-
-            def micro(carry, mb):
-                gsum, lsum = carry
-                loss, grads = jax.value_and_grad(self._micro_loss)(
-                    state["params"], mb, scale)
-                grads = constrain(
-                    jax.tree_util.tree_map(lambda g: g.astype(jnp.float32),
-                                           grads),
-                    self.mesh, self.grad_specs)
-                gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
-                return (gsum, lsum + loss), None
-
-            zeros = _tree_zeros_f32(state["params"])
-            if gas == 1:
-                sq = jax.tree_util.tree_map(lambda x: x[0], batch)
-                (gsum, lsum), _ = micro((zeros, jnp.zeros((), jnp.float32)),
-                                        sq)
-            else:
-                (gsum, lsum), _ = jax.lax.scan(
-                    micro, (zeros, jnp.zeros((), jnp.float32)), batch)
-
+            gsum, lsum = self._accumulate_micro_grads(state, batch, scale)
             new_state, metrics = self._apply_grads(state, gsum, float(gas))
             metrics["loss"] = lsum / (scale * gas)
             return new_state, metrics
@@ -372,6 +488,23 @@ class DeepSpeedEngine:
     def train_step(self, batch: Dict) -> Dict:
         """One full optimizer step (gas microbatches). Returns metrics dict
         of device scalars."""
+        if self.offload_enabled:
+            if any(not isinstance(v, jax.Array) for v in
+                   jax.tree_util.tree_leaves(batch)):
+                batch = self.shard_batch(batch)
+            t0 = time.perf_counter()
+            metrics = self._offload_train_step(batch)
+            self.global_steps += 1
+            self.micro_steps += self.gradient_accumulation_steps
+            if self._config.wall_clock_breakdown:
+                self._step_times.append(time.perf_counter() - t0)
+            if self._config.steps_per_print and \
+                    self.global_steps % self._config.steps_per_print == 0:
+                logger.info(
+                    f"step={self.global_steps} loss={metrics['loss']:.4f} "
+                    f"lr={metrics['lr']:.3e} "
+                    f"grad_norm={metrics['grad_norm']:.3f}")
+            return metrics
         if self._train_step_fn is None:
             self._build_train_step()
         if any(not isinstance(v, jax.Array) for v in
@@ -438,6 +571,10 @@ class DeepSpeedEngine:
     # 1910, 2121). Each call is an independent jitted program.
     # ------------------------------------------------------------------
     def forward(self, batch: Dict) -> jnp.ndarray:
+        if self.offload_enabled:
+            raise NotImplementedError(
+                "the compat forward/backward/step surface is not wired for "
+                "optimizer offload — use train_step()/train_batch()")
         self._last_batch = batch if isinstance(
             next(iter(jax.tree_util.tree_leaves(batch))), jax.Array) \
             else jax.device_put(batch, to_named(
@@ -504,6 +641,8 @@ class DeepSpeedEngine:
 
     @property
     def loss_scale(self) -> float:
+        if self._host_scaler is not None:
+            return self._host_scaler.scale
         if self.loss_scaler is None:
             return 1.0
         return float(self.state["scaler"].scale)

@@ -1,0 +1,159 @@
+"""ZeRO-Offload tier 1: optimizer state + fp32 masters in host DRAM.
+
+Role-equivalent of the reference's CPU offload path — ZeRO
+``offload_optimizer: {device: cpu}`` wiring in
+`/root/reference/deepspeed/runtime/zero/stage_1_and_2.py` (cpu_offload flag)
+and `stage3.py:480` (_configure_tensor_swapping), with the host update done
+by ``DeepSpeedCPUAdam`` (`csrc/adam/cpu_adam.cpp`). TPU redesign:
+
+  - Device HBM holds ONLY the compute-dtype (bf16) parameters; fp32 masters
+    + Adam moments are host numpy, stepped by the native library
+    (`ops/csrc/cpu_adam.cpp`). That is 12 host bytes vs 2 device bytes per
+    parameter — the "params/chip" lever of BASELINE.md.
+  - One jitted program computes summed grads + their norm; the host folds
+    loss-scale x microbatch-count x clip-factor into the C++ sweep's single
+    grad multiply; the updated bf16 copies (produced in the same sweep)
+    are uploaded back into the parameter shardings.
+  - fp16 dynamic loss scaling runs its state machine host-side (the jitted
+    version lives in `runtime/fp16/loss_scaler.py`; semantics identical).
+
+The transfer pattern is device→host grads, host→device params each step —
+the same wire traffic as the reference's cpu_offload, scheduled by
+dispatch/donation instead of CUDA streams.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+from ...utils.logging import logger
+
+
+class HostLossScaler:
+    """Host-side mirror of DynamicLossScaler's state machine."""
+
+    def __init__(self, scaler):
+        self.scale = float(scaler.initial_scale)
+        self.window = scaler.scale_window
+        self.min_scale = scaler.min_scale
+        self.factor = scaler.scale_factor
+        self.hysteresis0 = scaler.hysteresis
+        self.hysteresis = scaler.hysteresis
+        self.good_steps = 0
+        self.detect_overflow = scaler.detect_overflow
+
+    def update(self, overflow: bool) -> None:
+        if overflow:
+            self.hysteresis = max(self.hysteresis - 1, 0)
+            if self.hysteresis <= 0:
+                self.scale = max(self.scale / self.factor, self.min_scale)
+                self.hysteresis = self.hysteresis0
+            self.good_steps = 0
+        else:
+            self.good_steps += 1
+            if self.good_steps >= self.window:
+                self.scale *= self.factor
+                self.good_steps = 0
+                self.hysteresis = self.hysteresis0
+
+
+class ZeroOffloadHostOptimizer:
+    """Host half of the offload engine: masters + moments + step."""
+
+    def __init__(self, engine, init_params_f32):
+        cfg = engine._config
+        oc = cfg.optimizer
+        name = (oc.type if oc is not None else "adamw").lower()
+        params = dict(oc.params) if oc is not None else {}
+        lr = params.pop("lr", 1e-3)
+        self.leaves, self.treedef = jax.tree_util.tree_flatten(
+            init_params_f32)
+        host = [np.asarray(l, dtype=np.float32) for l in self.leaves]
+
+        from ...ops.adam.cpu_adam import (DeepSpeedCPUAdam,
+                                          DeepSpeedCPUAdagrad)
+        if name in ("adam", "adamw", "fusedadam", "cpuadam",
+                    "deepspeedcpuadam"):
+            betas = params.pop("betas", (0.9, 0.999))
+            self.opt = DeepSpeedCPUAdam(
+                host, lr=lr, betas=tuple(betas),
+                eps=params.pop("eps", 1e-8),
+                weight_decay=params.pop("weight_decay", 0.0),
+                adamw_mode=params.pop("adam_w_mode", name != "adam"))
+        elif name in ("adagrad", "cpuadagrad"):
+            self.opt = DeepSpeedCPUAdagrad(
+                host, lr=lr, eps=params.pop("eps", 1e-10),
+                weight_decay=params.pop("weight_decay", 0.0))
+        else:
+            raise NotImplementedError(
+                f"offload_optimizer supports Adam/AdamW/Adagrad, got {name} "
+                f"(reference cpu_offload has the same restriction)")
+        self.lr_default = lr
+        self._bf16 = None   # upload buffers, allocated on first bf16 emit
+        self.host_bytes = sum(
+            sum(a.nbytes for a in arrs)
+            for arrs in self.opt.state_arrays().values())
+
+    def step(self, grad_leaves: List[np.ndarray], lr: float,
+             grad_scale: float, emit_bf16: bool) -> List[np.ndarray]:
+        """Update masters in place; return the new device-upload arrays
+        (bf16 views when emit_bf16, else the fp32 masters)."""
+        if emit_bf16 and self._bf16 is None:
+            self._bf16 = [np.empty(m.shape, np.uint16)
+                          for m in self.opt.master]
+        self.opt.step(grad_leaves, lr=lr, grad_scale=grad_scale,
+                      out_bf16=self._bf16 if emit_bf16 else None)
+        if emit_bf16:
+            return [b.view(ml_dtypes.bfloat16) for b in self._bf16]
+        return self.opt.master
+
+    def reset_from_params(self, params_tree) -> None:
+        """Re-derive masters from a (restored) device param tree and zero
+        the moments — the module-only / no-optimizer-states load path."""
+        leaves = jax.tree_util.tree_leaves(jax.device_get(params_tree))
+        sd = self.opt.state_arrays()
+        fresh = {name: ([np.asarray(l, dtype=np.float32) for l in leaves]
+                        if name == "master"
+                        else [np.zeros_like(a) for a in arrs])
+                 for name, arrs in sd.items()}
+        self.opt.load_state_arrays(fresh, step_count=0)
+
+    # -- checkpoint --------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        return {"arrays": self.opt.state_arrays(),
+                "step_count": self.opt.step_count}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.opt.load_state_arrays(sd["arrays"], int(sd["step_count"]))
+
+
+def validate_offload_config(cfg) -> bool:
+    """Returns True when cpu optimizer offload is active; raises on config
+    the framework cannot honor yet (silent no-ops are bugs — VERDICT)."""
+    z = cfg.zero_config
+    oo, op = z.offload_optimizer, z.offload_param
+    from ...runtime.config import OffloadDeviceEnum as E
+    if op is not None and op.device != E.none:
+        raise NotImplementedError(
+            "offload_param (parameter offload to host/NVMe) is not "
+            "implemented yet — remove the block; optimizer offload "
+            "(offload_optimizer: {device: cpu}) is available")
+    if oo is None or oo.device == E.none:
+        return False
+    if oo.device == E.nvme:
+        raise NotImplementedError(
+            "offload_optimizer device=nvme needs the aio tier (not built "
+            "yet); device=cpu is available")
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "optimizer offload is single-controller-per-host only for now: "
+            "on a multi-host mesh every process would gather full masters "
+            "(device_get of non-addressable shards fails) — disable offload "
+            "or run single-host")
+    if cfg.aio is not None and getattr(cfg.aio, "_explicit", False):
+        pass  # aio block is harmless config until nvme lands
+    return True

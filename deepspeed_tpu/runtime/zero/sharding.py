@@ -106,7 +106,8 @@ class ZeroShardingPolicy:
     def __init__(self, stage: int, mesh: Mesh,
                  param_specs: Any, param_shapes: Any,
                  scan_axis_paths: Sequence[str] = ("blocks",),
-                 min_partition_size: int = 0):
+                 min_partition_size: int = 0,
+                 param_persistence_threshold: int = 0):
         if not 0 <= stage <= 3:
             raise ValueError(f"ZeRO stage must be 0..3, got {stage}")
         self.stage = stage
@@ -114,19 +115,30 @@ class ZeroShardingPolicy:
         self.param_specs = param_specs
         self.param_shapes = param_shapes
         self.scan_axis_paths = tuple(scan_axis_paths)
+        # stage-3: params below param_persistence_threshold elements stay
+        # resident (replicated) instead of sharded+gathered per use — the
+        # reference's persisted-param set (zero/config.py). Folding it into
+        # min_partition_size applies it to every stage-3 spec tree (live
+        # params, masters, grads, moments), which is the whole point of
+        # persistence: small tensors aren't worth the collective.
+        if stage >= 3:
+            min_partition_size = max(min_partition_size,
+                                     param_persistence_threshold)
         self.min_partition_size = min_partition_size
+        self.param_persistence_threshold = param_persistence_threshold
 
     # -- helpers -----------------------------------------------------------
     def _is_scan_path(self, path) -> bool:
         return bool(path) and getattr(path[0], "key", None) in self.scan_axis_paths
 
-    def _sharded_tree(self, exclude_scan_dim: bool):
+    def _sharded_tree(self, exclude_scan_dim: bool, min_size: int = None):
+        if min_size is None:
+            min_size = self.min_partition_size
         def f(path, spec, shp):
             shape = tuple(getattr(shp, "shape", shp))
             excl = (0,) if (exclude_scan_dim and self._is_scan_path(path)) else ()
             return shard_over_axis(spec, shape, self.mesh, DATA_AXIS,
-                                   exclude_dims=excl,
-                                   min_size=self.min_partition_size)
+                                   exclude_dims=excl, min_size=min_size)
         return jax.tree_util.tree_map_with_path(
             f, self.param_specs, self.param_shapes,
             is_leaf=lambda x: isinstance(x, P) or x is None)

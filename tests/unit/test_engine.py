@@ -93,11 +93,26 @@ class TestZeroParity:
         assert "data" in str(p.sharding.spec)
 
     def test_stage3_param_sharded_excluding_scan_axis(self):
-        engine, _ = run_steps(base_config(zero_optimization={"stage": 3}), n=1)
+        # persistence threshold 0: tiny test params must actually shard
+        engine, _ = run_steps(base_config(zero_optimization={
+            "stage": 3, "param_persistence_threshold": 0}), n=1)
         p = engine.state["params"]["blocks"]["mlp"]["fc_in"]["kernel"]
         spec = p.sharding.spec
         assert spec[0] is None          # scan/layer axis never sharded
         assert "data" in str(spec)
+
+    def test_stage3_param_persistence_threshold(self):
+        """Params below the threshold stay resident (replicated) — the
+        reference's persisted-param set (stage3_param_persistence_threshold,
+        zero/config.py)."""
+        engine, losses = run_steps(base_config(zero_optimization={
+            "stage": 3, "param_persistence_threshold": 10 ** 9}), n=2)
+        p = engine.state["params"]["blocks"]["mlp"]["fc_in"]["kernel"]
+        assert "data" not in str(p.sharding.spec)  # everything persisted
+        assert all(np.isfinite(losses))
+        _, ref = run_steps(base_config(zero_optimization={
+            "stage": 3, "param_persistence_threshold": 0}), n=2)
+        np.testing.assert_allclose(losses, ref, rtol=1e-4)
 
     def test_zero_with_tp_mesh(self):
         cfg = base_config(mesh={"data": 4, "model": 2},
