@@ -17,22 +17,10 @@ import time
 import numpy as np
 
 
-# bf16 peak FLOPS per chip by TPU generation (dense MXU).
-PEAK_FLOPS = {
-    "v4": 275e12,
-    "v5 lite": 197e12, "v5e": 197e12,
-    "v5": 459e12, "v5p": 459e12,
-    "v6 lite": 918e12, "v6e": 918e12,
-    "cpu": 1e12,  # nominal, so CPU runs still report a number
-}
-
-
 def chip_peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "cpu").lower()
-    for key, val in sorted(PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
-        if key in kind:
-            return val
-    return 197e12
+    from deepspeed_tpu.profiling.flops_profiler import (
+        chip_peak_flops as _peak)
+    return _peak(device)
 
 
 def main():

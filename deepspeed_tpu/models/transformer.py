@@ -168,6 +168,7 @@ class TransformerLM:
                  constrain: Optional[Callable] = None):
         self.config = config
         self.constrain = constrain or (lambda x: x)
+        self.mesh = None          # bound by the engine (ring attention)
         if config.pos_embedding == "rotary":
             self._cos, self._sin = L.rotary_freqs(
                 config.hdim, config.rotary_dim, config.max_seq_len,
@@ -255,6 +256,25 @@ class TransformerLM:
                                                          0.02, dt)}
         return params
 
+    def bind_mesh(self, mesh) -> None:
+        """Attach the device mesh (needed by manual-collective attention
+        paths like ring attention). The engine calls this at init."""
+        self.mesh = mesh
+
+    _flash_fallback_warned = False
+
+    def _warn_flash_fallback(self, tq: int, tk: int) -> None:
+        """Loud (once) on the flash→XLA perf cliff — a silent fallback hides
+        an O(T²)-HBM regression (VERDICT weak #6)."""
+        if not TransformerLM._flash_fallback_warned:
+            from ..utils.logging import logger
+            logger.warning(
+                f"flash attention unsupported for seq {tq}/{tk} (block-size "
+                f"divisibility) — falling back to XLA attention, which "
+                f"materializes the [B,H,T,T] score matrix. Pad the sequence "
+                f"to a multiple of the flash block for the fast path.")
+            TransformerLM._flash_fallback_warned = True
+
     # -- block -------------------------------------------------------------
     def _attention(self, p, x, cache_kv=None, positions=None):
         c = self.config
@@ -272,6 +292,16 @@ class TransformerLM:
                                interleaved=c.rotary_interleaved)
         new_cache = None
         offset = 0
+        if cache_kv is None and c.attn_impl == "ring":
+            from ..ops.transformer.ring_attention import ring_attention
+            from ..parallel.topology import SEQUENCE_AXIS
+            if self.mesh is None or self.mesh.shape.get(SEQUENCE_AXIS, 1) < 2:
+                raise ValueError(
+                    "attn_impl='ring' needs a bound mesh with sequence>=2 "
+                    "(engine binds it; or call model.bind_mesh(mesh))")
+            o = ring_attention(q, k, v, self.mesh)
+            o = o.reshape(b, t, nh * hd)
+            return L.dense_apply(p["out"], o), None
         if cache_kv is None and c.attn_impl == "flash":
             from ..ops.transformer.flash_attention import (
                 flash_attention_bthd, supports)
@@ -279,6 +309,7 @@ class TransformerLM:
                 o = flash_attention_bthd(q, k, v)
                 o = o.reshape(b, t, nh * hd)
                 return L.dense_apply(p["out"], o), None
+            self._warn_flash_fallback(q.shape[1], k.shape[1])
         if cache_kv is not None:
             ck, cv, idx = cache_kv
             ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),

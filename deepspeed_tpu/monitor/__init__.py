@@ -1,0 +1,7 @@
+"""Monitors (TensorBoard / W&B / CSV) — counterpart of
+`/root/reference/deepspeed/monitor/`."""
+from .monitor import (CsvMonitor, Monitor, MonitorMaster, TensorBoardMonitor,
+                      WandbMonitor)
+
+__all__ = ["Monitor", "MonitorMaster", "TensorBoardMonitor", "WandbMonitor",
+           "CsvMonitor"]

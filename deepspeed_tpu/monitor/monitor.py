@@ -1,0 +1,167 @@
+"""Experiment monitors: TensorBoard / W&B / CSV.
+
+Role-equivalent of the reference monitor subsystem
+(`/root/reference/deepspeed/monitor/monitor.py:24` MonitorMaster fanning out
+to `tensorboard.py`, `wandb.py`, `csv_monitor.py`). Same event contract:
+``write_events([(name, value, step), ...])``; process-0-only in multi-host
+runs (rank filtering via jax.process_index instead of dist.get_rank).
+
+TensorBoard events go through torch.utils.tensorboard (always present in
+this environment); wandb is optional and degrades to a warning.
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+
+from ..utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    def __init__(self, config):
+        self.config = config
+        self.enabled = bool(getattr(config, "enabled", False)) and \
+            jax.process_index() == 0
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.writer = None
+        if not self.enabled:
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except ImportError:
+            logger.warning("tensorboard writer unavailable "
+                           "(torch.utils.tensorboard import failed)")
+            self.enabled = False
+            return
+        log_dir = os.path.join(config.output_path or "./runs",
+                               config.job_name)
+        os.makedirs(log_dir, exist_ok=True)
+        self.writer = SummaryWriter(log_dir=log_dir)
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            self.writer.add_scalar(name, value, step)
+
+    def flush(self) -> None:
+        if self.writer is not None:
+            self.writer.flush()
+
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        if not self.enabled:
+            return
+        try:
+            import wandb
+        except ImportError:
+            logger.warning("wandb not installed; wandb monitor disabled")
+            self.enabled = False
+            return
+        self._wandb = wandb
+        wandb.init(project=config.project, group=config.group,
+                   entity=config.team)
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            self._wandb.log({name: value}, step=step)
+
+    def close(self) -> None:
+        if self.enabled:
+            self._wandb.finish()
+
+
+class CsvMonitor(Monitor):
+    """One CSV file per metric name (reference csv_monitor.py behavior)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        if not self.enabled:
+            return
+        self.dir = os.path.join(config.output_path or "./csv_logs",
+                                config.job_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self._files = {}
+
+    def _writer(self, name: str):
+        if name not in self._files:
+            fname = os.path.join(
+                self.dir, name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            f = open(fname, "a", newline="")
+            w = csv.writer(f)
+            if new:
+                w.writerow(["step", name])
+            self._files[name] = (f, w)
+        return self._files[name]
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            _, w = self._writer(name)
+            w.writerow([step, value])
+
+    def flush(self) -> None:
+        if not self.enabled:
+            return
+        for f, _ in self._files.values():
+            f.flush()
+
+    def close(self) -> None:
+        if not self.enabled:
+            return
+        for f, _ in self._files.values():
+            f.close()
+        self._files = {}
+
+
+class MonitorMaster(Monitor):
+    """Fan-out to every enabled backend (reference monitor.py:24)."""
+
+    def __init__(self, monitor_config):
+        self.config = monitor_config
+        self.tb = TensorBoardMonitor(monitor_config.tensorboard)
+        self.wandb = WandbMonitor(monitor_config.wandb)
+        self.csv = CsvMonitor(monitor_config.csv_monitor)
+        self.backends = [m for m in (self.tb, self.wandb, self.csv)
+                         if m.enabled]
+        self.enabled = bool(self.backends)
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        for m in self.backends:
+            m.write_events(events)
+
+    def flush(self) -> None:
+        for m in self.backends:
+            m.flush()
+
+    def close(self) -> None:
+        for m in self.backends:
+            m.close()

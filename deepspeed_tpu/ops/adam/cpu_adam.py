@@ -164,7 +164,7 @@ class DeepSpeedCPUAdagrad:
 
 
 def f32_to_bf16_numpy(a: np.ndarray) -> np.ndarray:
-    """Round-to-nearest-even fp32 → bf16 bits (numpy fallback path)."""
-    x = a.astype(np.float32).view(np.uint32)
-    lsb = (x >> 16) & 1
-    return ((x + 0x7FFF + lsb) >> 16).astype(np.uint16)
+    """Round-to-nearest-even fp32 → bf16 bits (numpy fallback path);
+    ml_dtypes does the RNE conversion, matching the C++ f32_to_bf16."""
+    import ml_dtypes
+    return a.astype(np.float32).astype(ml_dtypes.bfloat16).view(np.uint16)

@@ -27,8 +27,22 @@ class BuildError(RuntimeError):
 
 
 def _source_hash(path: str) -> str:
+    """Cache tag = source bytes + host arch fingerprint: -march=native
+    binaries must never be shared across hosts (SIGILL on a lesser CPU)."""
+    import platform
+    h = hashlib.sha256()
     with open(path, "rb") as f:
-        return hashlib.sha256(f.read()).hexdigest()[:16]
+        h.update(f.read())
+    h.update(platform.machine().encode())
+    try:
+        with open("/proc/cpuinfo", "rb") as f:
+            for line in f:
+                if line.startswith((b"flags", b"Features", b"model name")):
+                    h.update(line)
+                    break
+    except OSError:
+        pass
+    return h.hexdigest()[:16]
 
 
 def build_and_load(name: str, extra_flags: Optional[list] = None,

@@ -1,0 +1,1 @@
+"""Profiling — counterpart of `/root/reference/deepspeed/profiling/`."""

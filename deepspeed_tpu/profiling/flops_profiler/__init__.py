@@ -1,0 +1,5 @@
+from .profiler import (FlopsProfiler, chip_peak_flops, compiled_cost,
+                       transformer_flops_per_token)
+
+__all__ = ["FlopsProfiler", "chip_peak_flops", "compiled_cost",
+           "transformer_flops_per_token"]

@@ -95,6 +95,8 @@ class DeepSpeedEngine:
             model.loss if hasattr(model, "loss") else None)
         if self._loss_fn is None:
             raise ValueError("Need model.loss or an explicit loss_fn")
+        if hasattr(model, "bind_mesh"):
+            model.bind_mesh(self.mesh)
 
         # -- optimizer -----------------------------------------------------
         self.optimizer = self._configure_optimizer(optimizer)
@@ -144,6 +146,16 @@ class DeepSpeedEngine:
         self.global_steps = 0
         self.micro_steps = 0
         self._step_times: list = []
+
+        # -- observability (reference MonitorMaster at engine.py:287,
+        #    ThroughputTimer/EngineTimers at engine.py:149) ----------------
+        from ..monitor.monitor import MonitorMaster
+        from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+        self.monitor = MonitorMaster(self._config.monitor)
+        seq_len = getattr(getattr(model, "config", None), "max_seq_len", 0)
+        self.tput_timer = ThroughputTimer(self.train_batch_size, seq_len)
+        self.timers = SynchronizedWallClockTimer()
+        self._analytic_flops_per_step = None
 
         # -- ZeRO-Offload tier 1 (host DRAM optimizer) ---------------------
         from .zero.offload import validate_offload_config
@@ -493,17 +505,14 @@ class DeepSpeedEngine:
                    jax.tree_util.tree_leaves(batch)):
                 batch = self.shard_batch(batch)
             t0 = time.perf_counter()
+            self.tput_timer.start()
             metrics = self._offload_train_step(batch)
+            self.tput_timer.stop()  # host step is synchronous already
             self.global_steps += 1
             self.micro_steps += self.gradient_accumulation_steps
             if self._config.wall_clock_breakdown:
                 self._step_times.append(time.perf_counter() - t0)
-            if self._config.steps_per_print and \
-                    self.global_steps % self._config.steps_per_print == 0:
-                logger.info(
-                    f"step={self.global_steps} loss={metrics['loss']:.4f} "
-                    f"lr={metrics['lr']:.3e} "
-                    f"grad_norm={metrics['grad_norm']:.3f}")
+            self._post_step_observe(metrics, batch)
             return metrics
         if self._train_step_fn is None:
             self._build_train_step()
@@ -519,20 +528,91 @@ class DeepSpeedEngine:
                         f"micro*dp, ...]; got {leaf.shape} — pass host "
                         f"arrays or use engine.shard_batch()")
         t0 = time.perf_counter()
+        self.tput_timer.start()
         self.state, metrics = self._train_step_fn(self.state, batch)
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
+        # sync whenever anything CONSUMES the timing (monitor, breakdown, or
+        # the periodic print) — unsynced stop() would time async-dispatch
+        # enqueue, inflating tok/s and MFU by orders of magnitude
+        sync = (self.monitor.enabled or self._config.wall_clock_breakdown
+                or bool(self._config.steps_per_print))
+        self.tput_timer.stop(sync=metrics["loss"] if sync else None)
         if self._config.wall_clock_breakdown:
             jax.block_until_ready(metrics["loss"])
             self._step_times.append(time.perf_counter() - t0)
-        if self._config.steps_per_print and \
-                self.global_steps % self._config.steps_per_print == 0:
-            m = {k: float(v) for k, v in metrics.items()}
+        self._post_step_observe(metrics, batch)
+        return metrics
+
+    def _post_step_observe(self, metrics: Dict, batch) -> None:
+        """Monitor events at the GAS boundary + periodic log line
+        (reference engine.py:1938 loss writes, :2270 _write_monitor)."""
+        cfg = self._config
+        do_print = cfg.steps_per_print and \
+            self.global_steps % cfg.steps_per_print == 0
+        if not (do_print or self.monitor.enabled):
+            return
+        m = {k: float(v) for k, v in metrics.items()}
+        if self.monitor.enabled:
+            step = self.global_steps
+            events = [("Train/loss", m["loss"], step),
+                      ("Train/lr", m["lr"], step),
+                      ("Train/grad_norm", m["grad_norm"], step),
+                      ("Train/loss_scale", m.get("loss_scale", 1.0), step)]
+            if self.tput_timer.timed_steps > 0:
+                events.append(("Train/samples_per_sec",
+                               self.tput_timer.samples_per_sec, step))
+                if self.tput_timer.seq_length:
+                    events.append(("Train/tokens_per_sec",
+                                   self.tput_timer.tokens_per_sec, step))
+                mfu = self._try_mfu(batch)
+                if mfu is not None:
+                    events.append(("Train/mfu", mfu, step))
+            self.monitor.write_events(events)
+            self.monitor.flush()
+        if do_print:
+            extra = ""
+            if self.tput_timer.timed_steps > 0:
+                extra = f" tok/s={self.tput_timer.tokens_per_sec:,.0f}"
+                mfu = self._try_mfu(batch)
+                if mfu is not None:
+                    extra += f" mfu={100 * mfu:.1f}%"
             logger.info(
                 f"step={self.global_steps} loss={m['loss']:.4f} "
                 f"lr={m['lr']:.3e} grad_norm={m['grad_norm']:.3f} "
-                f"loss_scale={m.get('loss_scale', 1.0):.0f}")
-        return metrics
+                f"loss_scale={m.get('loss_scale', 1.0):.0f}{extra}")
+
+    def _try_mfu(self, batch) -> Optional[float]:
+        """Engine-reported MFU from ANALYTIC flops (6N + attention) — the
+        bench script no longer owns this number (VERDICT missing #7).
+        Deliberately not XLA cost analysis here: that would lower+compile a
+        second copy of the train step mid-loop; the explicit FlopsProfiler
+        API is where users pay that cost knowingly."""
+        del batch
+        if self.offload_enabled:
+            return None  # offload step is host-bound; MFU is not the metric
+        if self.tput_timer.timed_steps == 0:
+            return None
+        try:
+            if self._analytic_flops_per_step is None:
+                from ..profiling.flops_profiler.profiler import (
+                    chip_peak_flops, transformer_flops_per_token)
+                mcfg = getattr(self.model, "config", None)
+                if mcfg is None or not hasattr(mcfg, "d_model"):
+                    return None
+                seq = self.tput_timer.seq_length or mcfg.max_seq_len
+                self._analytic_flops_per_step = (
+                    self.train_batch_size * seq *
+                    transformer_flops_per_token(
+                        self.num_parameters(), mcfg.num_layers,
+                        mcfg.d_model, seq))
+                self._peak_flops = chip_peak_flops() * max(
+                    jax.device_count(), 1)
+            return (self._analytic_flops_per_step /
+                    self.tput_timer.avg_step_time / self._peak_flops)
+        except Exception as e:  # observability must never kill training
+            logger.debug(f"mfu unavailable: {e}")
+            return None
 
     def train_batch(self, data_iter: Optional[Iterable] = None,
                     batch: Optional[Dict] = None) -> Dict:

@@ -128,6 +128,11 @@ class PipelineEngine(DeepSpeedEngine):
             model, self.num_stages)
         self.adapter = adapter
         mcfg = adapter.config
+        if getattr(mcfg, "attn_impl", None) == "ring":
+            raise NotImplementedError(
+                "ring attention (sequence parallel) inside the compiled "
+                "pipeline loop would nest manual collectives over "
+                "pipe+sequence — not supported yet; use ring without PP")
         if getattr(mcfg, "moe_enabled", False) and \
                 mcfg.moe_noisy_gate_policy == "RSample":
             raise NotImplementedError(

@@ -131,14 +131,13 @@ class ZeroShardingPolicy:
     def _is_scan_path(self, path) -> bool:
         return bool(path) and getattr(path[0], "key", None) in self.scan_axis_paths
 
-    def _sharded_tree(self, exclude_scan_dim: bool, min_size: int = None):
-        if min_size is None:
-            min_size = self.min_partition_size
+    def _sharded_tree(self, exclude_scan_dim: bool):
         def f(path, spec, shp):
             shape = tuple(getattr(shp, "shape", shp))
             excl = (0,) if (exclude_scan_dim and self._is_scan_path(path)) else ()
             return shard_over_axis(spec, shape, self.mesh, DATA_AXIS,
-                                   exclude_dims=excl, min_size=min_size)
+                                   exclude_dims=excl,
+                                   min_size=self.min_partition_size)
         return jax.tree_util.tree_map_with_path(
             f, self.param_specs, self.param_shapes,
             is_leaf=lambda x: isinstance(x, P) or x is None)

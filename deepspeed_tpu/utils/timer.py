@@ -1,0 +1,125 @@
+"""Wall-clock + throughput timers.
+
+Role-equivalent of the reference `/root/reference/deepspeed/utils/timer.py`
+(``SynchronizedWallClockTimer``, ``ThroughputTimer``). "Synchronized" here
+means `jax.block_until_ready` on a marker array instead of
+`torch.cuda.synchronize` — under async dispatch a bare perf_counter would
+time the Python enqueue, not the device work.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+from .logging import logger
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self._start: Optional[float] = None
+        self._elapsed = 0.0
+        self.count = 0
+
+    def start(self) -> None:
+        self._start = time.perf_counter()
+
+    def stop(self, sync=None) -> None:
+        if self._start is None:
+            return
+        if sync is not None:
+            jax.block_until_ready(sync)
+        self._elapsed += time.perf_counter() - self._start
+        self._start = None
+        self.count += 1
+
+    def reset(self) -> None:
+        self._start = None
+        self._elapsed = 0.0
+        self.count = 0
+
+    def elapsed(self, reset: bool = True) -> float:
+        out = self._elapsed
+        if reset:
+            self.reset()
+        return out
+
+    def mean(self) -> float:
+        return self._elapsed / max(self.count, 1)
+
+
+class SynchronizedWallClockTimer:
+    """Named-timer registry (reference timer.py SynchronizedWallClockTimer)."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def log(self, names: List[str], normalizer: float = 1.0,
+            reset: bool = True, memory_breakdown=None) -> str:
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}ms")
+        line = " | ".join(parts)
+        if line:
+            logger.info(f"time (ms) | {line}")
+        return line
+
+
+class ThroughputTimer:
+    """Samples/sec + tokens/sec over a sliding window of steps (reference
+    ThroughputTimer: batch-size-aware, skips warmup steps)."""
+
+    def __init__(self, batch_size: int, seq_length: int = 0,
+                 start_step: int = 2, steps_per_output: int = 0):
+        self.batch_size = batch_size
+        self.seq_length = seq_length
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.step_count = 0
+        self.total_elapsed = 0.0
+        self.timed_steps = 0
+        self._t0: Optional[float] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, sync=None) -> None:
+        if self._t0 is None:
+            return
+        if sync is not None:
+            jax.block_until_ready(sync)
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        self.step_count += 1
+        if self.step_count > self.start_step:   # skip compile/warmup steps
+            self.total_elapsed += dt
+            self.timed_steps += 1
+
+    @property
+    def avg_step_time(self) -> float:
+        return self.total_elapsed / max(self.timed_steps, 1)
+
+    @property
+    def samples_per_sec(self) -> float:
+        if self.timed_steps == 0:
+            return 0.0
+        return self.batch_size / self.avg_step_time
+
+    @property
+    def tokens_per_sec(self) -> float:
+        return self.samples_per_sec * self.seq_length
+
+    def summary(self) -> Dict[str, float]:
+        return {"avg_step_time_s": self.avg_step_time,
+                "samples_per_sec": self.samples_per_sec,
+                "tokens_per_sec": self.tokens_per_sec,
+                "timed_steps": float(self.timed_steps)}

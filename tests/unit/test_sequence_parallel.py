@@ -1,0 +1,141 @@
+"""Sequence parallelism (ring attention) tests — 8-device CPU mesh.
+
+Capability gap the reference v0.8.2 does not cover (SURVEY §5.7): long
+sequences via context parallelism over the ``sequence`` mesh axis.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import TransformerLM, gpt2_config
+from deepspeed_tpu.models import layers as L
+from deepspeed_tpu.ops.transformer.ring_attention import ring_attention
+from deepspeed_tpu.parallel.topology import build_mesh
+from deepspeed_tpu.runtime.config import MeshConfig
+
+
+def seq_mesh(seq=4, data=2):
+    return build_mesh(MeshConfig(data=data, sequence=seq))
+
+
+class TestRingAttentionOp:
+    @pytest.mark.parametrize("seq_par,t", [(4, 64), (8, 32), (2, 16)])
+    def test_fwd_matches_full_attention(self, seq_par, t):
+        mesh = build_mesh(MeshConfig(data=8 // seq_par, sequence=seq_par))
+        b, h, d = 2, 4, 16
+        q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (b, t, h, d))
+                   for i in range(3))
+        with mesh:
+            out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))(
+                q, k, v)
+        ref = L.causal_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_bwd_matches_full_attention(self):
+        mesh = seq_mesh()
+        b, t, h, d = 2, 32, 4, 16
+        q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (b, t, h, d))
+                   for i in range(3))
+
+        def f_ring(q, k, v):
+            return jnp.sum(ring_attention(q, k, v, mesh) ** 2)
+
+        def f_ref(q, k, v):
+            return jnp.sum(L.causal_attention(q, k, v) ** 2)
+
+        with mesh:
+            g_ring = jax.jit(jax.grad(f_ring, argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g_ring, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=5e-4)
+
+    def test_rejects_indivisible_seq(self):
+        mesh = seq_mesh()
+        q = jnp.zeros((1, 30, 2, 8))
+        with pytest.raises(ValueError, match="divisible"):
+            ring_attention(q, q, q, mesh)
+
+
+class TestSequenceParallelTraining:
+    def _model(self, attn="xla", seq=64):
+        cfg = gpt2_config("125m", num_layers=4, d_model=32, num_heads=4,
+                          vocab_size=64, max_seq_len=seq, dtype=jnp.float32,
+                          attn_impl=attn)
+        return TransformerLM(cfg)
+
+    def _losses(self, model, mesh_conf, n=3, seq=64):
+        config = {
+            "train_batch_size": 32,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "mesh": mesh_conf, "steps_per_print": 0,
+        }
+        engine, _, _, _ = ds.initialize(model=model, config=config,
+                                        rng=jax.random.PRNGKey(0))
+        rs = np.random.RandomState(0)
+        out = []
+        for i in range(n):
+            b = {"input_ids": rs.randint(0, 64, (32, seq), dtype=np.int32)}
+            out.append(float(engine.train_step(b)["loss"]))
+        return out
+
+    def test_ring_training_matches_dense(self):
+        """SP(4) x DP(2) ring-attention training == single-program XLA
+        attention (same seeds) — the VERDICT's required numerics check."""
+        ref = self._losses(self._model("xla"), {"data": 8})
+        ring = self._losses(self._model("ring"), {"data": 2, "sequence": 4})
+        np.testing.assert_allclose(ref, ring, rtol=2e-4)
+
+    def test_ring_with_zero2(self):
+        model = self._model("ring")
+        config = {
+            "train_batch_size": 16, "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "mesh": {"data": 2, "sequence": 4}, "steps_per_print": 0,
+        }
+        engine, _, _, _ = ds.initialize(model=model, config=config,
+                                        rng=jax.random.PRNGKey(0))
+        rs = np.random.RandomState(0)
+        losses = [float(engine.train_step(
+            {"input_ids": rs.randint(0, 64, (16, 64), dtype=np.int32)})
+            ["loss"]) for _ in range(2)]
+        assert all(np.isfinite(losses))
+
+    def test_long_sequence_2k(self):
+        """A 2048-token step through ring attention (8-way sequence) —
+        the long-context configuration on the virtual mesh."""
+        model = self._model("ring", seq=2048)
+        config = {
+            "train_batch_size": 2, "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "mesh": {"sequence": 8}, "steps_per_print": 0,
+        }
+        engine, _, _, _ = ds.initialize(model=model, config=config,
+                                        rng=jax.random.PRNGKey(0))
+        rs = np.random.RandomState(0)
+        loss = float(engine.train_step(
+            {"input_ids": rs.randint(0, 64, (2, 2048), dtype=np.int32)})
+            ["loss"])
+        assert np.isfinite(loss)
+
+    def test_ring_requires_mesh(self):
+        model = self._model("ring")
+        with pytest.raises(ValueError, match="ring"):
+            model.loss(model.init(jax.random.PRNGKey(0)),
+                       {"input_ids": jnp.zeros((2, 64), jnp.int32)})
+
+    def test_pipeline_rejects_ring(self):
+        from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+        mesh = build_mesh(MeshConfig(pipe=2, data=4))
+        with pytest.raises(NotImplementedError, match="ring"):
+            PipelineEngine(model=self._model("ring"),
+                           config={"train_batch_size": 8,
+                                   "gradient_accumulation_steps": 2,
+                                   "mesh": {"pipe": 2, "data": 4},
+                                   "steps_per_print": 0},
+                           mesh=mesh)
