@@ -35,8 +35,10 @@ def main():
     size = "125m" if on_tpu else None
 
     if size:
+        # remat=full + chunk 256 measured fastest across the round-2 sweep
+        # (see BENCH_NOTES.md; the chip is HBM-BW-bound at ~164 GB/s)
         cfg = gpt2_config(size, max_seq_len=seq, remat="full",
-                          attn_impl="flash")
+                          attn_impl="flash", loss_chunk=256)
     else:
         cfg = gpt2_config("125m", num_layers=4, d_model=256, num_heads=8,
                           vocab_size=50304, max_seq_len=seq)

@@ -1,0 +1,92 @@
+"""Multi-config benchmark suite (BASELINE.json tracked configs).
+
+Prints one JSON line per config. `bench.py` stays the driver's headline
+single-line contract; this script covers the wider matrix: 125M ZeRO-0,
+350M ZeRO-2/3, decode latency.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def train_bench(size: str, micro: int, seq: int, zero_stage: int,
+                iters: int = 10, **cfg_kw):
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+    from deepspeed_tpu.profiling.flops_profiler import chip_peak_flops
+
+    cfg = gpt2_config(size, max_seq_len=seq, remat="full",
+                      attn_impl="flash", loss_chunk=256, **cfg_kw)
+    model = TransformerLM(cfg)
+    engine, _, _, _ = ds.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 6e-4, "weight_decay": 0.1}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": zero_stage},
+        "gradient_clipping": 1.0, "steps_per_print": 0})
+    rs = np.random.RandomState(0)
+    batch = {"input_ids": rs.randint(0, cfg.vocab_size, (micro, seq),
+                                     dtype=np.int32)}
+    m = engine.train_step(batch)
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        m = engine.train_step(batch)
+    float(m["loss"])
+    dt = time.perf_counter() - t0
+    tok = micro * seq * iters / dt
+    n = engine.num_parameters()
+    fpt = 6 * n + 12 * cfg.num_layers * cfg.d_model * seq
+    mfu = tok * fpt / chip_peak_flops(jax.devices()[0])
+    print(json.dumps({
+        "metric": f"gpt2_{size}_zero{zero_stage}_tokens_per_sec_per_chip",
+        "value": round(tok, 1), "unit": "tokens/s",
+        "mfu": round(mfu, 4), "vs_baseline": round(mfu / 0.45, 4)}),
+        flush=True)
+
+
+def decode_bench(size: str = "125m", batch: int = 4, prompt: int = 64,
+                 new: int = 64):
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+
+    cfg = gpt2_config(size, max_seq_len=prompt + new, attn_impl="flash",
+                      dtype=jnp.bfloat16)
+    eng = ds.init_inference(TransformerLM(cfg), config={
+        "dtype": "bfloat16", "max_out_tokens": prompt + new})
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, cfg.vocab_size, (batch, prompt), dtype=np.int32)
+    for _ in range(3):
+        eng.generate(ids, max_new_tokens=new, temperature=0.0)
+    stats = eng.latency_stats()
+    print(json.dumps({
+        "metric": f"gpt2_{size}_decode_p50_ms_per_token",
+        "value": round(stats["p50_ms"], 3), "unit": "ms",
+        "p90_ms": round(stats["p90_ms"], 3),
+        "decode_tokens_per_sec": round(stats["tokens_per_sec"], 1)}),
+        flush=True)
+
+
+def main():
+    import jax
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if on_tpu:
+        train_bench("125m", 64, 1024, 0)
+        train_bench("350m", 16, 1024, 2, iters=6)
+        train_bench("350m", 16, 1024, 3, iters=6)
+        decode_bench()
+    else:
+        train_bench("125m", 2, 128, 0, iters=3, num_layers=4, d_model=256,
+                    num_heads=8)
+
+
+if __name__ == "__main__":
+    main()

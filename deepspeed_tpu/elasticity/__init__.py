@@ -1,0 +1,6 @@
+"""Elastic training — counterpart of `/root/reference/deepspeed/elasticity/`."""
+from .elasticity import (ElasticityError, ElasticityIncompatibleWorldSize,
+                         compute_elastic_config)
+
+__all__ = ["compute_elastic_config", "ElasticityError",
+           "ElasticityIncompatibleWorldSize"]

@@ -1,0 +1,183 @@
+"""Elastic training config math.
+
+Role-equivalent of the reference elasticity solver
+(`/root/reference/deepspeed/elasticity/elasticity.py:287`
+compute_elastic_config, `:61` get_candidate_batch_sizes, `:125` v0.1,
+`:173` v0.2): given acceptable micro-batch sizes and a ceiling on the
+global batch, pick ONE global batch size valid across the widest range of
+chip counts, so scale-up/scale-down events never change the effective
+batch. The math is backend-agnostic — "gpus" below are chips.
+
+The capability the torchelastic-based DSElasticAgent adds in the reference
+(worker monitoring + re-rendezvous) maps on TPU pods to the platform's
+slice-repair + `jax.distributed.initialize` re-init; the config solver is
+the portable part and lives here.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import logger
+
+LATEST_VERSION = 0.2
+
+# Highly composite numbers: batch sizes with many divisors maximize the set
+# of chip counts that divide them evenly (same table idea as the reference).
+_HCN = [1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840,
+        1260, 1680, 2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720,
+        45360, 50400, 55440, 83160, 110880, 166320, 221760, 277200,
+        332640, 498960, 554400, 665280, 720720]
+
+
+class ElasticityError(ValueError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+def _lcm(values: Sequence[int]) -> int:
+    out = 1
+    for v in values:
+        out = out * v // math.gcd(out, v)
+    return out
+
+
+def candidate_batch_sizes(bases: Sequence[int],
+                          max_batch: int) -> List[int]:
+    """For each base, the largest base x HCN ≤ max_batch."""
+    out = set()
+    for base in bases:
+        if base >= max_batch:
+            out.add(base)
+            continue
+        limit = max_batch // base
+        scale = max(h for h in _HCN if h <= limit)
+        out.add(base * scale)
+    return sorted(out)
+
+
+def valid_chip_counts(batch_size: int, micro_batches: Sequence[int],
+                      min_chips: int, max_chips: int) -> List[int]:
+    """All chip counts n in [min, max] such that some micro-batch m gives
+    batch_size = m * gas * n exactly (n divides batch_size/m)."""
+    valid = set()
+    for m in micro_batches:
+        if batch_size % m:
+            continue
+        slots = batch_size // m
+        for n in range(1, int(math.isqrt(slots)) + 1):
+            if slots % n == 0:
+                for cand in (n, slots // n):
+                    if min_chips <= cand <= max_chips:
+                        valid.add(cand)
+    return sorted(valid)
+
+
+def _solve_v01(micro_batches: Sequence[int], max_batch: int,
+               min_chips: int, max_chips: int,
+               prefer_larger: bool) -> Tuple[int, List[int]]:
+    if any(m > max_batch for m in micro_batches):
+        raise ElasticityError(
+            f"every micro batch must be <= max_acceptable_batch_size "
+            f"({max_batch}); got {sorted(micro_batches)}")
+    bases = list(micro_batches) + [_lcm(micro_batches)]
+    best_batch, best_valid = min(micro_batches), []
+    for cand in candidate_batch_sizes(bases, max_batch):
+        valid = valid_chip_counts(cand, micro_batches, min_chips, max_chips)
+        better = len(valid) > len(best_valid) or (
+            len(valid) == len(best_valid)
+            and ((prefer_larger and cand > best_batch)
+                 or (not prefer_larger and cand < best_batch)))
+        if better:
+            best_batch, best_valid = cand, valid
+    return best_batch, best_valid
+
+
+def _solve_v02(micro_batches: Sequence[int], max_batch: int,
+               current_chips: int, min_chips: int, max_chips: int,
+               prefer_larger: bool, chips_per_node: int,
+               model_parallel_size: int
+               ) -> Tuple[int, List[int], Optional[int]]:
+    """Node-granular + model-parallel-aware variant (reference v0.2)."""
+    if chips_per_node % model_parallel_size:
+        raise ElasticityError(
+            f"chips_per_node ({chips_per_node}) must divide by "
+            f"model_parallel_size ({model_parallel_size})")
+    dp_per_node = chips_per_node // model_parallel_size
+
+    def micro_for(batch: int) -> Optional[int]:
+        picked = None
+        for m in micro_batches:
+            if (batch // current_chips) % m == 0:
+                if picked is None or (prefer_larger and m > picked):
+                    picked = m
+        return picked
+
+    node_batch, node_counts = _solve_v01(
+        micro_batches, max_batch // dp_per_node,
+        max(min_chips // chips_per_node, 1),
+        max(max_chips // chips_per_node, 1), prefer_larger)
+    batch = node_batch * dp_per_node
+    dp_sizes = [n * dp_per_node for n in node_counts]
+    if current_chips // model_parallel_size in dp_sizes:
+        return batch, dp_sizes, micro_for(batch)
+
+    # current world incompatible with the widest config: fall back to the
+    # largest batch this world CAN run (reference behavior)
+    current_dp = (current_chips // chips_per_node) * dp_per_node
+    if current_dp < 1:
+        raise ElasticityIncompatibleWorldSize(
+            f"current world ({current_chips} chips) is smaller than one "
+            f"node ({chips_per_node} chips) — v0.2 elasticity is "
+            f"node-granular")
+    cands = [m * current_dp * (max_batch // (m * current_dp))
+             for m in micro_batches if m * current_dp <= max_batch]
+    if not cands:
+        raise ElasticityIncompatibleWorldSize(
+            f"no micro batch fits: chips={current_chips} max={max_batch}")
+    batch = max(cands) if prefer_larger else min(cands)
+    return batch, [current_dp], micro_for(batch)
+
+
+def compute_elastic_config(ds_config: Dict, target_version: float = None,
+                           world_size: int = 0,
+                           return_microbatch: bool = False):
+    """Entry point (reference elasticity.py:287): reads the ``elasticity``
+    block of the master config, returns (final_batch, valid_chip_counts[,
+    micro_batch]) and validates the current world size if given."""
+    ecfg = dict(ds_config.get("elasticity", {}))
+    if not ecfg.get("enabled", False):
+        raise ElasticityError("elasticity block missing or not enabled")
+    micro_batches = sorted(set(ecfg["micro_batch_sizes"]))
+    if not micro_batches or any(
+            not isinstance(m, int) or m < 1 for m in micro_batches):
+        raise ElasticityError(
+            f"micro_batch_sizes must be positive ints, got {micro_batches}")
+    max_batch = int(ecfg["max_acceptable_batch_size"])
+    version = float(target_version if target_version is not None
+                    else ecfg.get("version", LATEST_VERSION))
+    min_chips = int(ecfg.get("min_gpus", 1))
+    max_chips = int(ecfg.get("max_gpus", max_batch // micro_batches[0]))
+    prefer_larger = bool(ecfg.get("prefer_larger_batch", True))
+
+    micro = None
+    if version >= 0.2:
+        batch, valid, micro = _solve_v02(
+            micro_batches, max_batch, world_size or min_chips, min_chips,
+            max_chips, prefer_larger,
+            int(ecfg.get("num_gpus_per_node", 1)),
+            int(ecfg.get("model_parallel_size", 1)))
+    else:
+        batch, valid = _solve_v01(micro_batches, max_batch, min_chips,
+                                  max_chips, prefer_larger)
+    if world_size and version < 0.2 and world_size not in valid:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {world_size} not in the valid set {valid} for "
+            f"elastic batch {batch}")
+    logger.info(f"elasticity: batch={batch} valid_chip_counts={valid}")
+    if return_microbatch:
+        return batch, valid, micro
+    return batch, valid

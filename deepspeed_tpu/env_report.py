@@ -1,0 +1,69 @@
+"""Environment / compatibility report (``dstpu_report``).
+
+Role-equivalent of the reference ``ds_report``
+(`/root/reference/deepspeed/env_report.py`): print versions, device
+inventory, and the native-op compatibility matrix.
+"""
+from __future__ import annotations
+
+import shutil
+import sys
+
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def _ver(mod_name: str) -> str:
+    try:
+        mod = __import__(mod_name)
+        return getattr(mod, "__version__", "?")
+    except ImportError:
+        return "not installed"
+
+
+def op_report() -> list:
+    """Native-op compatibility matrix (reference op_report): can each host
+    op build here?"""
+    from .ops.op_builder import is_compatible
+    rows = []
+    for op in ("cpu_adam",):
+        rows.append((op, is_compatible(op)))
+    return rows
+
+
+def main(argv=None) -> int:
+    del argv
+    import jax
+    print("-" * 60)
+    print("deepspeed_tpu environment report (ds_report parity)")
+    print("-" * 60)
+    print(f"python ............... {sys.version.split()[0]}")
+    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint",
+                "numpy", "torch", "transformers"):
+        print(f"{mod:21s}... {_ver(mod)}")
+    import deepspeed_tpu
+    print(f"{'deepspeed_tpu':21s}... {deepspeed_tpu.__version__}")
+    print("-" * 60)
+    try:
+        devs = jax.devices()
+        print(f"backend .............. {devs[0].platform} "
+              f"({len(devs)} device(s))")
+        for d in devs[:8]:
+            print(f"  device {d.id}: {getattr(d, 'device_kind', '?')}")
+        if len(devs) > 8:
+            print(f"  ... and {len(devs) - 8} more")
+    except RuntimeError as e:
+        print(f"backend .............. UNAVAILABLE ({e})")
+    print(f"g++ .................. "
+          f"{'found' if shutil.which('g++') else 'missing'}")
+    print("-" * 60)
+    print("native op compatibility:")
+    for name, ok in op_report():
+        print(f"  {name:20s} {GREEN_OK if ok else RED_NO}")
+    print("-" * 60)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,6 @@
+"""Launcher — counterpart of `/root/reference/deepspeed/launcher/`."""
+from .runner import (decode_world_info, encode_world_info, fetch_hostfile,
+                     filter_resources, main)
+
+__all__ = ["fetch_hostfile", "filter_resources", "encode_world_info",
+           "decode_world_info", "main"]

@@ -1,0 +1,174 @@
+"""Launcher + elasticity tests.
+
+Reference coverage model: `/root/reference/tests/unit/launcher/`
+(hostfile/arg parsing, runner command construction) and
+`tests/unit/elasticity/test_elastic.py` (config math v0.1/v0.2).
+"""
+import subprocess
+import sys
+from collections import OrderedDict
+
+import pytest
+
+from deepspeed_tpu.elasticity import (ElasticityError,
+                                      ElasticityIncompatibleWorldSize,
+                                      compute_elastic_config)
+from deepspeed_tpu.elasticity.elasticity import (candidate_batch_sizes,
+                                                 valid_chip_counts)
+from deepspeed_tpu.launcher.runner import (RUNNERS, decode_world_info,
+                                           encode_world_info, fetch_hostfile,
+                                           filter_resources, parse_args)
+
+
+class TestHostfile:
+    def test_parse(self, tmp_path):
+        hf = tmp_path / "hostfile"
+        hf.write_text("# comment\nworker-1 slots=4\nworker-2 slots=8\n\n")
+        pool = fetch_hostfile(str(hf))
+        assert pool == OrderedDict([("worker-1", 4), ("worker-2", 8)])
+
+    def test_duplicate_host_rejected(self, tmp_path):
+        hf = tmp_path / "hostfile"
+        hf.write_text("w1 slots=2\nw1 slots=4\n")
+        with pytest.raises(ValueError, match="duplicate"):
+            fetch_hostfile(str(hf))
+
+    def test_empty_rejected(self, tmp_path):
+        hf = tmp_path / "hostfile"
+        hf.write_text("# nothing\n")
+        with pytest.raises(ValueError, match="empty"):
+            fetch_hostfile(str(hf))
+
+
+class TestFilters:
+    POOL = OrderedDict([("w1", 4), ("w2", 4), ("w3", 2)])
+
+    def test_include_hosts_and_slots(self):
+        out = filter_resources(self.POOL, include="w1@0,2;w3")
+        assert out == OrderedDict([("w1", [0, 2]), ("w3", [0, 1])])
+
+    def test_exclude(self):
+        out = filter_resources(self.POOL, exclude="w2;w1@3")
+        assert out == OrderedDict([("w1", [0, 1, 2]), ("w3", [0, 1])])
+
+    def test_mutually_exclusive(self):
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            filter_resources(self.POOL, include="w1", exclude="w2")
+
+    def test_unknown_host(self):
+        with pytest.raises(ValueError, match="not in hostfile"):
+            filter_resources(self.POOL, include="nope")
+
+    def test_world_info_roundtrip(self):
+        active = filter_resources(self.POOL, exclude="w3")
+        assert decode_world_info(encode_world_info(active)) == {
+            "w1": [0, 1, 2, 3], "w2": [0, 1, 2, 3]}
+
+
+class TestRunnerCommands:
+    def _args(self, launcher="ssh"):
+        return parse_args([f"--launcher={launcher}", "train.py", "--lr",
+                           "1e-4"])
+
+    def test_ssh_cmds(self):
+        args = self._args()
+        active = OrderedDict([("h1", [0]), ("h2", [0])])
+        cmds = RUNNERS["ssh"](args, active).get_cmd()
+        assert len(cmds) == 2
+        assert cmds[0][0] == "ssh" and "h1" in cmds[0]
+        joined = " ".join(cmds[0])
+        assert "COORDINATOR_ADDRESS=h1:8476" in joined
+        assert "NUM_PROCESSES=2" in joined and "PROCESS_ID=0" in joined
+        assert "PROCESS_ID=1" in " ".join(cmds[1])
+
+    def test_openmpi_cmd(self):
+        args = self._args("openmpi")
+        active = OrderedDict([("h1", [0]), ("h2", [0])])
+        (cmd,) = RUNNERS["openmpi"](args, active).get_cmd()
+        assert cmd[0] == "mpirun" and "-n" in cmd and "2" in cmd
+
+    def test_slurm_cmd(self):
+        args = self._args("slurm")
+        active = OrderedDict([("h1", [0])])
+        (cmd,) = RUNNERS["slurm"](args, active).get_cmd()
+        assert cmd[0] == "srun"
+
+    def test_cli_dry_run(self, tmp_path):
+        hf = tmp_path / "hostfile"
+        hf.write_text("h1 slots=1\nh2 slots=1\n")
+        out = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+             "-H", str(hf), "--dry_run", "train.py"],
+            capture_output=True, text=True, cwd="/root/repo")
+        assert out.returncode == 0, out.stderr
+        lines = [l for l in out.stdout.splitlines() if l.startswith("ssh")]
+        assert len(lines) == 2
+
+
+class TestElasticity:
+    BASE = {"elasticity": {"enabled": True,
+                           "micro_batch_sizes": [2, 4, 6],
+                           "max_acceptable_batch_size": 10000,
+                           "min_gpus": 1, "max_gpus": 10000,
+                           "version": 0.1}}
+
+    def test_candidates_are_hcn_scaled(self):
+        cands = candidate_batch_sizes([2, 4], 100)
+        assert all(c <= 100 for c in cands)
+        assert 96 in cands   # 4 * 24
+
+    def test_valid_chip_counts(self):
+        valid = valid_chip_counts(48, [2, 4], 1, 100)
+        # 48/2=24 slots and 48/4=12 slots → all divisors of 24 and 12
+        assert 24 in valid and 12 in valid and 1 in valid and 8 in valid
+
+    def test_v01_solution_validity(self):
+        batch, valid = compute_elastic_config(self.BASE)
+        assert batch <= 10000 and len(valid) > 20
+        for n in valid[:10]:
+            assert any(batch % (m * n) == 0 for m in [2, 4, 6])
+
+    def test_v01_incompatible_world_size(self):
+        cfg = {"elasticity": {**self.BASE["elasticity"],
+                              "max_acceptable_batch_size": 24,
+                              "max_gpus": 12}}
+        batch, valid = compute_elastic_config(cfg)
+        bad = max(valid) + 1
+        while bad in valid:
+            bad += 1
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            compute_elastic_config(cfg, world_size=bad)
+
+    def test_v02_node_granular(self):
+        cfg = {"elasticity": {**self.BASE["elasticity"], "version": 0.2,
+                              "num_gpus_per_node": 8,
+                              "model_parallel_size": 2}}
+        batch, valid, micro = compute_elastic_config(
+            cfg, world_size=16, return_microbatch=True)
+        assert batch > 0 and micro in (2, 4, 6)
+        assert all(v % 4 == 0 for v in valid)  # dp_per_node = 4
+
+    def test_v02_subnode_world_rejected(self):
+        cfg = {"elasticity": {**self.BASE["elasticity"], "version": 0.2,
+                              "num_gpus_per_node": 8,
+                              "max_acceptable_batch_size": 17,
+                              "micro_batch_sizes": [17]}}
+        with pytest.raises(ElasticityError):
+            compute_elastic_config(cfg, world_size=4)
+
+    def test_disabled_rejected(self):
+        with pytest.raises(ElasticityError, match="enabled"):
+            compute_elastic_config({"elasticity": {"enabled": False}})
+
+    def test_bad_micro_batches_rejected(self):
+        cfg = {"elasticity": {"enabled": True, "micro_batch_sizes": [0, 2],
+                              "max_acceptable_batch_size": 100}}
+        with pytest.raises(ElasticityError):
+            compute_elastic_config(cfg)
+
+    def test_mp_divisibility_rejected(self):
+        cfg = {"elasticity": {**self.BASE["elasticity"], "version": 0.2,
+                              "num_gpus_per_node": 8,
+                              "model_parallel_size": 3}}
+        with pytest.raises(ElasticityError, match="divide"):
+            compute_elastic_config(cfg, world_size=8)
