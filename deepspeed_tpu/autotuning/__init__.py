@@ -1,0 +1,4 @@
+"""Autotuning — counterpart of `/root/reference/deepspeed/autotuning/`."""
+from .autotuner import Autotuner
+
+__all__ = ["Autotuner"]

@@ -1,0 +1,126 @@
+"""Autotuner: config-space search over measured train steps.
+
+Role-equivalent of the reference autotuner
+(`/root/reference/deepspeed/autotuning/autotuner.py:421` Autotuner.tune,
+tuners in `autotuning/tuner/`): generate experiments over the
+(micro-batch, ZeRO-stage) space, run a few measured steps each, and pick
+the fastest config. Redesign notes:
+
+  - The reference schedules experiments as separate launcher jobs across
+    nodes (ResourceManager); here each experiment is an engine build + a
+    few steps in-process — on TPU the "job" boundary is just a new jit.
+  - Tuner strategies: grid (exhaustive) and model_based (cost-model-
+    pruned: skip configs whose predicted memory exceeds HBM), mirroring
+    index_based/model_based tuners.
+"""
+from __future__ import annotations
+
+import copy
+import itertools
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.logging import logger
+
+DEFAULT_MICRO_BATCHES = (1, 2, 4, 8, 16, 32, 64)
+DEFAULT_ZERO_STAGES = (0, 1, 2, 3)
+
+
+class Autotuner:
+    def __init__(self, model, base_config: Dict[str, Any],
+                 micro_batches: Sequence[int] = DEFAULT_MICRO_BATCHES,
+                 zero_stages: Sequence[int] = DEFAULT_ZERO_STAGES,
+                 steps_per_trial: int = 3, tuner_type: str = "model_based",
+                 hbm_bytes: Optional[int] = None):
+        self.model = model
+        self.base_config = base_config
+        self.micro_batches = list(micro_batches)
+        self.zero_stages = list(zero_stages)
+        self.steps_per_trial = steps_per_trial
+        self.tuner_type = tuner_type
+        self.hbm_bytes = hbm_bytes
+        self.results: List[Dict[str, Any]] = []
+
+    # -- experiment generation (reference exps generation) -----------------
+    def generate_experiments(self) -> List[Dict[str, Any]]:
+        exps = []
+        for mb, stage in itertools.product(self.micro_batches,
+                                           self.zero_stages):
+            cfg = copy.deepcopy(self.base_config)
+            cfg["train_micro_batch_size_per_gpu"] = mb
+            cfg.pop("train_batch_size", None)
+            cfg.setdefault("zero_optimization", {})["stage"] = stage
+            exps.append(cfg)
+        if self.tuner_type == "model_based":
+            exps = [c for c in exps if self._predict_fits(c)]
+        return exps
+
+    def _predict_fits(self, cfg: Dict[str, Any]) -> bool:
+        """Cost-model pruning (reference model_based_tuner): param + opt +
+        activation memory estimate against HBM."""
+        if self.hbm_bytes is None:
+            import jax
+            stats = jax.devices()[0].memory_stats() or {}
+            self.hbm_bytes = stats.get("bytes_limit", 16 * 2 ** 30) or \
+                16 * 2 ** 30
+        mcfg = getattr(self.model, "config", None)
+        if mcfg is None:
+            return True
+        n = mcfg.num_params() if hasattr(mcfg, "num_params") else 0
+        stage = cfg.get("zero_optimization", {}).get("stage", 0)
+        import jax
+        dp = max(jax.device_count(), 1) if stage else 1
+        # bf16 params + f32 master/m/v (sharded by stage>=1) + grads
+        state = n * 2 + (n * 12) / (dp if stage >= 1 else 1) + n * 4 / (
+            dp if stage >= 2 else 1)
+        mb = cfg.get("train_micro_batch_size_per_gpu", 1)
+        acts = mb * mcfg.max_seq_len * mcfg.d_model * 2 * \
+            (mcfg.num_layers * 4)
+        return (state + acts) * 1.3 < self.hbm_bytes
+
+    # -- measurement -------------------------------------------------------
+    def _measure(self, cfg: Dict[str, Any],
+                 batch_fn: Callable[[int], Dict]) -> Optional[float]:
+        import deepspeed_tpu as ds
+        try:
+            engine, _, _, _ = ds.initialize(model=self.model,
+                                            config=copy.deepcopy(cfg))
+            batch = batch_fn(engine.train_batch_size)
+            m = engine.train_step(batch)
+            float(m["loss"])
+            t0 = time.perf_counter()
+            for _ in range(self.steps_per_trial):
+                m = engine.train_step(batch)
+            float(m["loss"])
+            dt = (time.perf_counter() - t0) / self.steps_per_trial
+            return engine.train_batch_size / dt
+        except Exception as e:
+            logger.warning(f"autotune experiment failed "
+                           f"(mb={cfg.get('train_micro_batch_size_per_gpu')}"
+                           f", zero={cfg.get('zero_optimization')}): "
+                           f"{type(e).__name__}: {str(e)[:120]}")
+            return None
+
+    def tune(self, batch_fn: Callable[[int], Dict]) -> Dict[str, Any]:
+        """Run all experiments; return the best config (highest
+        samples/sec). ``batch_fn(global_batch_size)`` supplies data."""
+        exps = self.generate_experiments()
+        logger.info(f"autotuning over {len(exps)} experiments")
+        best, best_tput = None, -1.0
+        for cfg in exps:
+            tput = self._measure(cfg, batch_fn)
+            self.results.append({
+                "micro_batch": cfg.get("train_micro_batch_size_per_gpu"),
+                "zero_stage": cfg["zero_optimization"]["stage"],
+                "samples_per_sec": tput})
+            if tput is not None and tput > best_tput:
+                best, best_tput = cfg, tput
+        if best is None:
+            raise RuntimeError("every autotuning experiment failed")
+        logger.info(
+            f"autotune best: mb={best['train_micro_batch_size_per_gpu']} "
+            f"zero={best['zero_optimization']['stage']} "
+            f"({best_tput:.1f} samples/s)")
+        return best

@@ -1,0 +1,6 @@
+"""Checkpoint tools — counterpart of `/root/reference/deepspeed/checkpoint/`."""
+from .universal import (export_universal, import_universal, load_universal,
+                        unflatten)
+
+__all__ = ["export_universal", "import_universal", "load_universal",
+           "unflatten"]

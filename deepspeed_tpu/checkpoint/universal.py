@@ -1,0 +1,93 @@
+"""Universal checkpoint tools.
+
+Role-equivalent of the reference checkpoint reshape library
+(`/root/reference/deepspeed/checkpoint/`: DeepSpeedCheckpoint,
+`universal_checkpoint.py:108`, reshape_3d_utils) and the offline
+`ds_to_universal` flow. Design note: the native checkpoint is ALREADY
+topology-free (one sharded pytree, orbax reshards on read — SURVEY §5.4),
+so the "universal" format here serves portability OUTSIDE the framework:
+a directory of plain ``.npy`` files + a JSON manifest, importable with
+nothing but numpy. The reference needs this machinery to merge per-rank
+shard files; here export/import is a flatten/unflatten.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def _flatten_paths(tree, prefix=""):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_paths(v, f"{prefix}{k}/"))
+        return out
+    out[prefix.rstrip("/")] = tree
+    return out
+
+
+def export_universal(ckpt_dir: str, out_dir: str,
+                     tag: Optional[str] = None) -> str:
+    """deepspeed_tpu checkpoint → universal dir of npy files + manifest.
+    The fp32 masters are used when the checkpoint carries offload state
+    (via get_fp32_state_dict_from_zero_checkpoint)."""
+    from ..runtime.checkpoint_engine.engine import (
+        get_fp32_state_dict_from_zero_checkpoint)
+    params = get_fp32_state_dict_from_zero_checkpoint(ckpt_dir, tag)
+    flat = _flatten_paths(params)
+    os.makedirs(out_dir, exist_ok=True)
+    manifest: Dict[str, Any] = {"format": "dstpu_universal_v1",
+                                "tensors": {}}
+    for name, arr in flat.items():
+        arr = np.asarray(arr)
+        fname = name.replace("/", ".") + ".npy"
+        np.save(os.path.join(out_dir, fname), arr)
+        manifest["tensors"][name] = {
+            "file": fname, "shape": list(arr.shape), "dtype": str(arr.dtype)}
+    with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    return out_dir
+
+
+def load_universal(universal_dir: str) -> Dict[str, np.ndarray]:
+    """universal dir → flat {path: array} dict."""
+    with open(os.path.join(universal_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != "dstpu_universal_v1":
+        raise ValueError(f"not a universal checkpoint: {universal_dir}")
+    return {name: np.load(os.path.join(universal_dir, meta["file"]))
+            for name, meta in manifest["tensors"].items()}
+
+
+def unflatten(flat: Dict[str, np.ndarray]) -> Dict:
+    """Flat path dict → nested params pytree."""
+    tree: Dict = {}
+    for path, arr in flat.items():
+        node = tree
+        parts = path.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return tree
+
+
+def import_universal(universal_dir: str, engine) -> None:
+    """Load universal params into a live engine (any topology — the
+    device_put reshards; the reference needs reshape_meg_2d for this)."""
+    import jax
+    params = unflatten(load_universal(universal_dir))
+
+    def put(arr, cur):
+        arr = np.asarray(arr)
+        if arr.shape != cur.shape:
+            raise ValueError(f"shape mismatch {arr.shape} vs {cur.shape}")
+        return jax.device_put(arr.astype(cur.dtype), cur.sharding)
+
+    engine.state["params"] = jax.tree_util.tree_map(
+        put, params, engine.state["params"])
+    if getattr(engine, "_host_opt", None) is not None:
+        # offload: fp32 masters re-derived from the imported params
+        engine._host_opt.reset_from_params(engine.state["params"])

@@ -70,6 +70,24 @@ def init_distributed(dist_backend: str = "xla",
         num_processes = int(env_np)
     if process_id is None and env_pid:
         process_id = int(env_pid)
+    if process_id is None and os.environ.get("DSTPU_WORLD_INFO"):
+        # launchers that can't template a per-host rank (pdsh over ssh)
+        # ship the world-info blob instead; the rank is this hostname's
+        # index in it (reference encodes world info the same way,
+        # launcher/runner.py world_info_base64)
+        import socket
+        from ..launcher.runner import decode_world_info
+        hosts = list(decode_world_info(os.environ["DSTPU_WORLD_INFO"]))
+        name = socket.gethostname()
+        matches = [i for i, h in enumerate(hosts)
+                   if h == name or name.startswith(h + ".")
+                   or h.startswith(name + ".")]
+        if len(matches) == 1:
+            process_id = matches[0]
+        else:
+            raise RuntimeError(
+                f"cannot derive PROCESS_ID: hostname {name!r} matches "
+                f"{len(matches)} entries of DSTPU_WORLD_INFO {hosts}")
     explicit = bool(coordinator_address or num_processes)
     if _init_mode in ("explicit", "auto"):
         return

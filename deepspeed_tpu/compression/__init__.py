@@ -1,0 +1,6 @@
+"""Compression — counterpart of `/root/reference/deepspeed/compression/`."""
+from .compress import (WeightQuantizeConfig, bits_at_step, compress_params,
+                       init_compression, post_training_quantize)
+
+__all__ = ["WeightQuantizeConfig", "bits_at_step", "compress_params",
+           "init_compression", "post_training_quantize"]

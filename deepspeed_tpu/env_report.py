@@ -15,8 +15,9 @@ RED_NO = "\033[91m[NO]\033[0m"
 
 
 def _ver(mod_name: str) -> str:
+    import importlib
     try:
-        mod = __import__(mod_name)
+        mod = importlib.import_module(mod_name)
         return getattr(mod, "__version__", "?")
     except ImportError:
         return "not installed"

@@ -175,11 +175,14 @@ class PDSHRunner(MultiNodeRunner):
 
     def get_cmd(self) -> List[List[str]]:
         hostlist = ",".join(self.hosts)
+        # pdsh over ssh cannot template a per-host rank (%n only expands
+        # under the 'exec' rcmd module) — ship the world-info blob and let
+        # comm.init_distributed derive PROCESS_ID from the hostname
         env = " ".join(
             ["COORDINATOR_ADDRESS="
              f"{self.hosts[0]}:{self.args.coordinator_port}",
              f"NUM_PROCESSES={len(self.hosts)}",
-             "PROCESS_ID=%n"])  # pdsh expands %n to the node index
+             f"DSTPU_WORLD_INFO={encode_world_info(self.world_info)}"])
         cmd = ["pdsh", "-S", "-f", "1024", "-w", hostlist,
                f"cd {shlex.quote(os.getcwd())}; {env} " +
                " ".join(shlex.quote(c) for c in self._user_cmd())]
@@ -261,6 +264,19 @@ def main(argv=None) -> int:
     active = filter_resources(pool, args.include, args.exclude)
     if not active:
         raise ValueError("no hosts left after include/exclude filtering")
+    if len(active) == 1 and next(iter(active)) in ("localhost",
+                                                   "127.0.0.1"):
+        # single local host: run in place, no ssh required (reference
+        # launcher short-circuits the multinode runner the same way)
+        env = dict(os.environ,
+                   COORDINATOR_ADDRESS=f"localhost:"
+                                       f"{args.coordinator_port}",
+                   NUM_PROCESSES="1", PROCESS_ID="0")
+        cmd = [sys.executable, args.user_script, *args.user_args]
+        if args.dry_run:
+            print(" ".join(cmd))
+            return 0
+        return subprocess.call(cmd, env=env)
     runner = RUNNERS[args.launcher](args, active)
     if not runner.backend_exists():
         raise RuntimeError(f"launcher backend {args.launcher!r} not found "

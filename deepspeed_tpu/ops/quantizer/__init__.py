@@ -1,0 +1,5 @@
+"""Quantization ops — counterpart of `/root/reference/csrc/quantization/`."""
+from .quantizer import (dequantize, fake_quantize, quantization_error,
+                        quantize)
+
+__all__ = ["quantize", "dequantize", "fake_quantize", "quantization_error"]

@@ -1,0 +1,87 @@
+"""Grouped quantization ops.
+
+Role-equivalent of the reference quantization kernels
+(`/root/reference/csrc/quantization/` quantize.cu / dequantize.cu /
+fake_quantizer.cu, bound via `ops/quantizer/quantizer.py`). On TPU these
+are pure jnp expressions XLA fuses into the surrounding graph — a custom
+kernel buys nothing for elementwise scale/round ops; the value is the
+*semantics*: grouped symmetric/asymmetric int quantization and the
+straight-through fake-quant used by QAT/MoQ.
+
+All functions operate on the LAST axis grouped into ``num_groups`` rows
+(the reference flattens to [groups, elems/group] the same way).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _grouped(x: jnp.ndarray, num_groups: int) -> Tuple[jnp.ndarray, tuple]:
+    shape = x.shape
+    flat = x.reshape(num_groups, -1)
+    return flat, shape
+
+
+def quantize(x: jnp.ndarray, num_bits: int = 8, num_groups: int = 1,
+             symmetric: bool = True
+             ) -> Tuple[jnp.ndarray, jnp.ndarray, Optional[jnp.ndarray]]:
+    """x → (int values, scale [G,1], zero_point [G,1] | None).
+
+    Symmetric: q = round(x / scale), scale = max|x| / qmax.
+    Asymmetric: q = round((x - min) / scale), range [0, 2^bits - 1]."""
+    flat, _ = _grouped(x.astype(jnp.float32), num_groups)
+    if symmetric:
+        qmax = 2.0 ** (num_bits - 1) - 1
+        scale = jnp.max(jnp.abs(flat), axis=1, keepdims=True) / qmax
+        scale = jnp.maximum(scale, 1e-12)
+        q = jnp.clip(jnp.round(flat / scale), -qmax - 1, qmax)
+        return q.astype(jnp.int8 if num_bits <= 8 else jnp.int32), \
+            scale, None
+    qmax = 2.0 ** num_bits - 1
+    lo = jnp.min(flat, axis=1, keepdims=True)
+    hi = jnp.max(flat, axis=1, keepdims=True)
+    scale = jnp.maximum((hi - lo) / qmax, 1e-12)
+    q = jnp.clip(jnp.round((flat - lo) / scale), 0, qmax)
+    return q.astype(jnp.uint8 if num_bits <= 8 else jnp.int32), scale, lo
+
+
+def dequantize(q: jnp.ndarray, scale: jnp.ndarray,
+               zero_point: Optional[jnp.ndarray], shape: tuple,
+               dtype=jnp.float32) -> jnp.ndarray:
+    flat = q.astype(jnp.float32) * scale
+    if zero_point is not None:
+        flat = flat + zero_point
+    return flat.reshape(shape).astype(dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def fake_quantize(x: jnp.ndarray, num_bits: int = 8, num_groups: int = 1,
+                  symmetric: bool = True) -> jnp.ndarray:
+    """Quantize→dequantize with a straight-through gradient (reference
+    fake_quantizer.cu — the QAT/MoQ training path)."""
+    q, scale, zp = quantize(x, num_bits, num_groups, symmetric)
+    return dequantize(q, scale, zp, x.shape, x.dtype)
+
+
+def _fq_fwd(x, num_bits, num_groups, symmetric):
+    return fake_quantize(x, num_bits, num_groups, symmetric), None
+
+
+def _fq_bwd(num_bits, num_groups, symmetric, _res, g):
+    return (g,)   # straight-through estimator
+
+
+fake_quantize.defvjp(_fq_fwd, _fq_bwd)
+
+
+def quantization_error(x: jnp.ndarray, num_bits: int = 8,
+                       num_groups: int = 1, symmetric: bool = True
+                       ) -> jnp.ndarray:
+    """Mean squared quantization error (used by MoQ's schedule decisions)."""
+    return jnp.mean(
+        (x.astype(jnp.float32)
+         - fake_quantize(x, num_bits, num_groups, symmetric)) ** 2)

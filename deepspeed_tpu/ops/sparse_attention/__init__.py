@@ -1,0 +1,13 @@
+"""Block-sparse attention — counterpart of
+`/root/reference/deepspeed/ops/sparse_attention/`."""
+from .sparse_self_attention import SparseSelfAttention
+from .sparsity_config import (BigBirdSparsityConfig,
+                              BSLongformerSparsityConfig,
+                              DenseSparsityConfig, FixedSparsityConfig,
+                              LocalSlidingWindowSparsityConfig,
+                              SparsityConfig, VariableSparsityConfig)
+
+__all__ = ["SparseSelfAttention", "SparsityConfig", "DenseSparsityConfig",
+           "FixedSparsityConfig", "VariableSparsityConfig",
+           "BigBirdSparsityConfig", "BSLongformerSparsityConfig",
+           "LocalSlidingWindowSparsityConfig"]

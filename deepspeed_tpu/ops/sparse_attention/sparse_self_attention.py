@@ -1,0 +1,104 @@
+"""Block-sparse self-attention.
+
+Role-equivalent of the reference's Triton block-sparse stack
+(`/root/reference/deepspeed/ops/sparse_attention/matmul.py:213`
+_sparse_matmul SDD/DSD/DDS, `softmax.py`, `sparse_self_attention.py`).
+TPU redesign: instead of LUT-driven Triton kernels, the layout's True
+blocks are GATHERED into a dense [nnz, block, block] batch, computed as one
+batched MXU matmul + masked softmax over gathered blocks, and combined
+back per query block. Everything is static-shaped (nnz is fixed by the
+layout), fully differentiable through gather/scatter, and XLA pipelines
+the block batch through the MXU.
+
+For a layout with nnz blocks of a possible n², compute and score-memory
+scale with nnz — the same asymptotic win the reference gets from Triton.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sparsity_config import SparsityConfig
+
+MASK_VALUE = -1e30
+
+
+class SparseSelfAttention:
+    """Callable attention module bound to a SparsityConfig (reference
+    `sparse_self_attention.py` SparseSelfAttention)."""
+
+    def __init__(self, sparsity_config: SparsityConfig,
+                 max_seq_length: int):
+        self.config = sparsity_config
+        self.block = sparsity_config.block
+        self.layout = sparsity_config.make_layout(max_seq_length)
+        if getattr(sparsity_config, "attention",
+                   "bidirectional") == "unidirectional":
+            # prune whole future blocks; the diagonal keeps in-block masking
+            self.layout = self.layout & np.tril(
+                np.ones_like(self.layout, bool))
+        rows, cols = np.nonzero(self.layout)
+        self._rows = jnp.asarray(rows)       # [nnz] query-block ids
+        self._cols = jnp.asarray(cols)       # [nnz] kv-block ids
+        n = self.layout.shape[0]
+        # per query block: how many nnz precede it (for segment combine)
+        self.nnz = len(rows)
+        self.num_blocks = n
+        # causal handling needs in-block masks on diagonal blocks
+        self._diag = jnp.asarray(rows == cols)
+
+    def __call__(self, q, k, v, sm_scale: Optional[float] = None):
+        """q, k, v: [B, T, H, D] → [B, T, H, D]. Layout True blocks only."""
+        b, t, h, d = q.shape
+        nb, blk = self.num_blocks, self.block
+        if t != nb * blk:
+            raise ValueError(f"seq {t} != layout {nb}x{blk}")
+        if sm_scale is None:
+            sm_scale = 1.0 / math.sqrt(d)
+
+        def pack(x):   # [B,T,H,D] -> [BH, nb, blk, D]
+            return (x.transpose(0, 2, 1, 3)
+                    .reshape(b * h, nb, blk, d))
+        qb, kb, vb = pack(q), pack(k), pack(v)
+
+        # SDD: gather block pairs, one batched matmul over nnz blocks
+        qg = qb[:, self._rows]                  # [BH, nnz, blk, D]
+        kg = kb[:, self._cols]
+        s = jnp.einsum("znqd,znkd->znqk", qg, kg,
+                       preferred_element_type=jnp.float32) * sm_scale
+        if getattr(self.config, "attention", "bidirectional") == \
+                "unidirectional":
+            row = jax.lax.broadcasted_iota(jnp.int32, (blk, blk), 0)
+            col = jax.lax.broadcasted_iota(jnp.int32, (blk, blk), 1)
+            diag_mask = row >= col
+            s = jnp.where(self._diag[None, :, None, None]
+                          & ~diag_mask[None, None], MASK_VALUE, s)
+
+        # sparse softmax across each query block's nnz row:
+        # segment-max / segment-sum over blocks sharing a query-block id
+        seg = self._rows
+        m_blk = jnp.max(s, axis=3)                          # [BH, nnz, blk]
+        m_row = jax.ops.segment_max(
+            m_blk.transpose(1, 0, 2), seg, num_segments=nb)  # [nb, BH, blk]
+        m = m_row[seg].transpose(1, 0, 2)                   # [BH, nnz, blk]
+        p = jnp.exp(s - m[..., None])
+        l_blk = jnp.sum(p, axis=3)
+        l_row = jax.ops.segment_sum(
+            l_blk.transpose(1, 0, 2), seg, num_segments=nb)
+        l = jnp.maximum(l_row[seg].transpose(1, 0, 2), 1e-20)
+        p = p / l[..., None]
+
+        # DSD: probs @ v, scatter-add per query block
+        vg = vb[:, self._cols]                              # [BH, nnz, blk, D]
+        ob = jnp.einsum("znqk,znkd->znqd", p.astype(v.dtype), vg)
+        out = jax.ops.segment_sum(
+            ob.transpose(1, 0, 2, 3), seg, num_segments=nb)  # [nb, BH, blk,D]
+        out = out.transpose(1, 0, 2, 3).reshape(b, h, t, d)
+        return out.transpose(0, 2, 1, 3)
+
+    def density(self) -> float:
+        return self.nnz / float(self.num_blocks ** 2)

@@ -1,0 +1,78 @@
+"""Curriculum learning scheduler.
+
+Role-equivalent of the reference ``CurriculumScheduler``
+(`/root/reference/deepspeed/runtime/data_pipeline/curriculum_scheduler.py`):
+difficulty (e.g. sequence length) ramps from ``min_difficulty`` to
+``max_difficulty`` under a schedule — fixed_linear, fixed_root,
+fixed_discrete, or custom — and the value is snapped down to a multiple of
+``difficulty_step`` (TPU-relevant: keeps seqlen tile-aligned so XLA reuses
+compiled programs).
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict):
+        self.enabled = bool(config.get("enabled", True))
+        self.min_difficulty = int(config["min_difficulty"])
+        self.max_difficulty = int(config["max_difficulty"])
+        self.schedule_type = config.get("schedule_type", "fixed_linear")
+        sc = config.get("schedule_config", {})
+        self.total_steps = int(sc.get("total_curriculum_step", 1)) or 1
+        self.difficulty_step = int(sc.get("difficulty_step", 1)) or 1
+        self.root_degree = int(sc.get("root_degree", 2))
+        self.discrete_difficulties = sc.get("difficulty", [])
+        self.discrete_steps = sc.get("max_step", [])
+        self._custom: Optional[Callable[[int], int]] = config.get(
+            "custom_get_difficulty")
+        if self.schedule_type == "fixed_discrete" and \
+                len(self.discrete_difficulties) != \
+                len(self.discrete_steps) + 1:
+            raise ValueError(
+                "fixed_discrete needs len(difficulty) == len(max_step) + 1")
+        if self.schedule_type == "custom" and self._custom is None:
+            raise ValueError("custom schedule needs custom_get_difficulty")
+
+    def _snap(self, d: float) -> int:
+        d = int(d // self.difficulty_step * self.difficulty_step)
+        return max(self.min_difficulty,
+                   min(d, self.max_difficulty))
+
+    def get_difficulty(self, global_step: int) -> int:
+        if not self.enabled:
+            return self.max_difficulty
+        t = min(max(global_step, 0), self.total_steps)
+        frac = t / self.total_steps
+        if self.schedule_type == "fixed_linear":
+            d = self.min_difficulty + frac * (self.max_difficulty
+                                              - self.min_difficulty)
+        elif self.schedule_type == "fixed_root":
+            d = self.min_difficulty + (frac ** (1.0 / self.root_degree)) * \
+                (self.max_difficulty - self.min_difficulty)
+        elif self.schedule_type == "fixed_discrete":
+            d = self.discrete_difficulties[-1]
+            for diff, step in zip(self.discrete_difficulties,
+                                  self.discrete_steps):
+                if global_step <= step:
+                    d = diff
+                    break
+            return int(d)   # discrete values are used verbatim
+        elif self.schedule_type == "custom":
+            return int(self._custom(global_step))
+        else:
+            raise ValueError(f"unknown schedule {self.schedule_type}")
+        return self._snap(math.ceil(d))
+
+    def truncate_batch(self, batch: Dict, global_step: int,
+                       seq_keys=("input_ids", "labels", "loss_mask")):
+        """Apply the current difficulty as a sequence-length truncation
+        (the reference's legacy curriculum seqlen path, engine.py:1800)."""
+        d = self.get_difficulty(global_step)
+        out = dict(batch)
+        for k in seq_keys:
+            if k in out and out[k].ndim >= 2 and out[k].shape[-1] > d:
+                out[k] = out[k][..., :d]
+        return out

@@ -1,0 +1,73 @@
+"""Random layerwise token dropping (random-LTD).
+
+Role-equivalent of the reference random-LTD
+(`/root/reference/deepspeed/runtime/data_pipeline/data_routing/
+basic_layer.py:117` RandomLayerTokenDrop + the gather/scatter CUDA kernels
+in `csrc/random_ltd/`): during training, middle layers process a random
+subset of tokens; the dropped tokens bypass the layer and are scattered
+back afterwards. On TPU the kernels collapse to `jnp.take_along_axis` /
+scatter — gather/scatter of [B, keep, D] is XLA-native.
+
+The kept-token count follows a linear schedule from ``start_ratio`` to 1.0
+over ``schedule_steps`` (the reference's seq-length schedule), snapped to
+``granularity`` for shape reuse.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomLTDConfig:
+    enabled: bool = False
+    start_ratio: float = 0.5       # fraction of tokens kept at step 0
+    schedule_steps: int = 10000
+    granularity: int = 16          # kept-count rounded to a multiple
+    # first/last layers always see all tokens (reference keeps the ends)
+    skip_first_layers: int = 1
+    skip_last_layers: int = 1
+
+
+def kept_tokens_at(cfg: RandomLTDConfig, seq_len: int, step: int) -> int:
+    """Host-side schedule: kept token count for this step (static per
+    compiled program — a new count recompiles, so granularity matters)."""
+    frac = min(max(step, 0) / max(cfg.schedule_steps, 1), 1.0)
+    ratio = cfg.start_ratio + frac * (1.0 - cfg.start_ratio)
+    keep = int(seq_len * ratio) // cfg.granularity * cfg.granularity
+    return min(max(keep, cfg.granularity), seq_len)
+
+
+def sample_indices(rng, batch: int, seq_len: int,
+                   keep: int) -> jnp.ndarray:
+    """[B, keep] sorted random token indices (reference token_sort.cu)."""
+    def one(key):
+        return jnp.sort(jax.random.permutation(key, seq_len)[:keep])
+    return jax.vmap(one)(jax.random.split(rng, batch))
+
+
+def gather_tokens(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """x [B, T, D], idx [B, keep] → [B, keep, D]
+    (reference gather_scatter.cu gather path)."""
+    return jnp.take_along_axis(x, idx[..., None], axis=1)
+
+
+def scatter_tokens(full: jnp.ndarray, part: jnp.ndarray,
+                   idx: jnp.ndarray) -> jnp.ndarray:
+    """Write the processed kept tokens back into the full stream."""
+    return jax.vmap(lambda f, p, i: f.at[i].set(p))(full, part, idx)
+
+
+def random_ltd_layer(layer_fn, x: jnp.ndarray, rng,
+                     keep: int) -> jnp.ndarray:
+    """Run ``layer_fn`` on a random token subset; dropped tokens pass
+    through unchanged (the residual identity of the reference)."""
+    b, t = x.shape[0], x.shape[1]
+    if keep >= t:
+        return layer_fn(x)
+    idx = sample_indices(rng, b, t, keep)
+    part = layer_fn(gather_tokens(x, idx))
+    return scatter_tokens(x, part, idx)

@@ -1,0 +1,28 @@
+"""Progressive layer drop schedule.
+
+Role-equivalent of the reference ``ProgressiveLayerDrop``
+(`/root/reference/deepspeed/runtime/progressive_layer_drop.py`): keep-prob
+theta(t) = (1 - gamma)·exp(-gamma·t) ... actually the reference uses
+theta(t) = theta_min + (1 - theta_min)·exp(-gamma·t) decayed per step; the
+model consumes theta as the per-layer survival probability (stochastic
+depth). Traceable in the step counter.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta_min = theta
+        self.gamma = gamma
+
+    def theta(self, global_step) -> jnp.ndarray:
+        """Keep probability at this step (→ theta_min as t→∞)."""
+        t = jnp.asarray(global_step, jnp.float32)
+        return (1.0 - self.theta_min) * jnp.exp(-self.gamma * t) \
+            + self.theta_min
+
+    def get_state(self, global_step) -> dict:
+        return {"progressive_layer_drop": True,
+                "pld_theta": self.theta(global_step)}

@@ -1,0 +1,65 @@
+"""TiledLinear: split one big linear into tiles.
+
+Role-equivalent of the reference ``TiledLinear`` (`/root/reference/
+deepspeed/runtime/zero/tiling.py:27`), which splits a huge nn.Linear into
+in/out tile grids so ZeRO-3 gathers one tile at a time. Functional form:
+params are a [rows, cols] grid of kernel tiles; applying scans over column
+tiles (a natural remat/gather boundary), accumulating partial products —
+the peak live weight memory is one tile row instead of the full matrix.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...models import layers as L
+
+
+class TiledLinear:
+    def __init__(self, in_features: int, out_features: int,
+                 in_splits: int = 1, out_splits: int = 1,
+                 use_bias: bool = True):
+        if in_features % in_splits or out_features % out_splits:
+            raise ValueError(
+                f"splits must divide features: {in_features}/{in_splits}, "
+                f"{out_features}/{out_splits}")
+        self.in_features = in_features
+        self.out_features = out_features
+        self.in_splits = in_splits
+        self.out_splits = out_splits
+        self.use_bias = use_bias
+        self.tile_in = in_features // in_splits
+        self.tile_out = out_features // out_splits
+
+    def init(self, rng, dtype=jnp.float32) -> Dict:
+        keys = jax.random.split(rng, self.in_splits)
+        # [in_splits, out_splits, tile_in, tile_out] stacked tile grid
+        kernel = jnp.stack([
+            jnp.stack([L.normal_init(k2, (self.tile_in, self.tile_out),
+                                     0.02, dtype)
+                       for k2 in jax.random.split(k, self.out_splits)])
+            for k in keys])
+        p = {"kernel": kernel}
+        if self.use_bias:
+            p["bias"] = jnp.zeros((self.out_features,), dtype)
+        return p
+
+    def apply(self, params, x):
+        """x [..., in] → [..., out]; scan over input tiles so only one tile
+        row of weights is live per step (the ZeRO-3 gather unit)."""
+        xt = x.reshape(*x.shape[:-1], self.in_splits, self.tile_in)
+        xt = jnp.moveaxis(xt, -2, 0)          # [in_splits, ..., tile_in]
+
+        def step(acc, inp):
+            xs, kt = inp                      # kt [out_splits, ti, to]
+            part = jnp.einsum("...i,oit->...ot", xs,
+                              kt.astype(xs.dtype))
+            return acc + part.reshape(*xs.shape[:-1], self.out_features), None
+
+        zero = jnp.zeros((*x.shape[:-1], self.out_features), x.dtype)
+        out, _ = jax.lax.scan(step, zero, (xt, params["kernel"]))
+        if self.use_bias:
+            out = out + params["bias"].astype(out.dtype)
+        return out

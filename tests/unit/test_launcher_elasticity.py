@@ -94,12 +94,15 @@ class TestRunnerCommands:
         assert cmd[0] == "srun"
 
     def test_cli_dry_run(self, tmp_path):
+        import os
         hf = tmp_path / "hostfile"
         hf.write_text("h1 slots=1\nh2 slots=1\n")
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
         out = subprocess.run(
             [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
              "-H", str(hf), "--dry_run", "train.py"],
-            capture_output=True, text=True, cwd="/root/repo")
+            capture_output=True, text=True, cwd=repo_root)
         assert out.returncode == 0, out.stderr
         lines = [l for l in out.stdout.splitlines() if l.startswith("ssh")]
         assert len(lines) == 2
