@@ -81,9 +81,11 @@ def run_benchmark(collective: str, sizes_mb: List[float], mesh=None,
     fn = _mk_collective(collective, mesh, axis)
     results = []
     for mb in sizes_mb:
-        elems = max(int(mb * 2 ** 20 // 4), n) // n * n
+        # n*n alignment: the all_to_all body re-splits the per-rank shard
+        elems = max(int(mb * 2 ** 20 // 4), n * n) // (n * n) * (n * n)
         x = jnp.arange(elems, dtype=jnp.float32)
-        for _ in range(warmups):
+        out = fn(x)
+        for _ in range(max(warmups - 1, 0)):
             out = fn(x)
         float(jnp.sum(out).ravel()[0])
         t0 = time.perf_counter()
@@ -91,7 +93,9 @@ def run_benchmark(collective: str, sizes_mb: List[float], mesh=None,
             out = fn(x)
         float(jnp.sum(out).ravel()[0])
         dt = (time.perf_counter() - t0) / trials
-        size = elems * 4
+        # ds_bench convention: size = the PER-RANK buffer each device
+        # contributes (the global array here is sharded n ways)
+        size = elems * 4 // n
         results.append({
             "collective": collective, "size_bytes": size,
             "latency_ms": round(dt * 1e3, 3),

@@ -104,7 +104,10 @@ def init_compression(model, compression_config: Dict[str, Any]):
 
 
 def post_training_quantize(params, cfg: WeightQuantizeConfig):
-    """One-shot PTQ of the weight leaves (serving-time compression)."""
-    frozen = dataclasses.replace(cfg, start_bits=cfg.target_bits,
+    """One-shot PTQ of the weight leaves (serving-time compression).
+    ``enabled`` is forced on — it's a training-schedule flag the PTQ
+    caller has no reason to set."""
+    frozen = dataclasses.replace(cfg, enabled=True,
+                                 start_bits=cfg.target_bits,
                                  quantize_period=1)
     return compress_params(params, frozen, jnp.asarray(10 ** 9))

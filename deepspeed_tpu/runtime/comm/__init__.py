@@ -1,0 +1,5 @@
+"""Compressed communication backends — counterpart of
+`/root/reference/deepspeed/runtime/comm/`."""
+from .compressed import compressed_allreduce, compression_ratio
+
+__all__ = ["compressed_allreduce", "compression_ratio"]

@@ -1,0 +1,83 @@
+"""Error-feedback sign-compressed allreduce.
+
+Role-equivalent of the reference 1-bit compression backends
+(`/root/reference/deepspeed/runtime/comm/nccl.py:52-204`
+NcclBackend.compressed_allreduce, `mpi.py` MpiBackend): the two-stage
+worker/server scheme of 1-bit Adam —
+
+  1. worker compensates its tensor with local error feedback, compresses to
+     sign + per-chunk scale, keeps the new compression error;
+  2. all_to_all moves chunk i of every worker to device i (the "server"
+     for that chunk);
+  3. the server averages the decompressed worker chunks, adds ITS error
+     feedback, recompresses (sign + scale), keeps the server error;
+  4. all_gather broadcasts the recompressed chunks back.
+
+TPU-native shape: a pure function usable inside `shard_map` manual over the
+compression axis (meant for ``dcn_data`` — ICI is fast enough that exact
+reduction wins there; DCN is where 1-bit pays). Signs travel as int8, so
+wire volume per direction is n/w bytes + one f32 scale per chunk vs 4n
+bytes for fp32 allreduce — the reference's ~26x compression.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def compression_ratio(numel: int, world: int) -> float:
+    """Compressed bytes / exact-allreduce bytes (both directions)."""
+    exact = 2 * 4.0 * numel * (world - 1) / world
+    compressed = 2 * (numel / world * 1.0 + 4.0)  # int8 signs + scale
+    return compressed * world / max(exact, 1e-9) / world
+
+
+def compressed_allreduce(
+        x: jnp.ndarray, worker_error: jnp.ndarray,
+        server_error: jnp.ndarray, axis: str
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Inside shard_map (manual over ``axis``). x: the local tensor (same
+    shape on every device, values differ); errors: local error-feedback
+    buffers shaped like x (worker) and x/w (server). Returns the averaged
+    tensor (identical on all devices) + updated error buffers.
+    """
+    w = jax.lax.psum(1, axis)
+    n = x.size
+    if n % w:
+        raise ValueError(f"tensor size {n} must divide by axis size {w}")
+    chunk = n // w
+    flat = x.reshape(-1).astype(jnp.float32)
+
+    # -- stage 1: worker compression (reference nccl.py:86-117) ----------
+    buf = flat + worker_error.reshape(-1)
+    chunks = buf.reshape(w, chunk)
+    scales = jnp.linalg.norm(chunks, axis=1) / jnp.sqrt(float(chunk))
+    signs = jnp.where(chunks >= 0, 1.0, -1.0)
+    decompressed = signs * scales[:, None]
+    new_worker_error = (buf - decompressed.reshape(-1)).reshape(x.shape)
+
+    # -- stage 2: all_to_all signs+scales to chunk servers ----------------
+    # row j of the result is worker j's chunk destined for THIS device
+    signs_i8 = signs.astype(jnp.int8)                      # wire format
+    recv_signs = jax.lax.all_to_all(signs_i8, axis, split_axis=0,
+                                    concat_axis=0).reshape(w, chunk)
+    recv_scales = jax.lax.all_to_all(scales, axis, split_axis=0,
+                                     concat_axis=0).reshape(w)
+
+    # -- stage 3: server average + recompression (nccl.py:141-171) --------
+    avg = jnp.mean(recv_signs.astype(jnp.float32)
+                   * recv_scales[:, None], axis=0)          # [chunk]
+    sbuf = avg + server_error.reshape(-1)
+    sscale = jnp.linalg.norm(sbuf) / jnp.sqrt(float(chunk))
+    ssign = jnp.where(sbuf >= 0, 1.0, -1.0)
+    new_server_error = (sbuf - ssign * sscale).reshape(server_error.shape)
+
+    # -- stage 4: all_gather the recompressed chunks ----------------------
+    out_signs = jax.lax.all_gather(ssign.astype(jnp.int8), axis)  # [w,chunk]
+    out_scales = jax.lax.all_gather(sscale, axis)                 # [w]
+    out = (out_signs.astype(jnp.float32)
+           * out_scales[:, None]).reshape(x.shape)
+    return out.astype(x.dtype), new_worker_error.astype(x.dtype), \
+        new_server_error.astype(jnp.float32)

@@ -74,6 +74,11 @@ class MMapIndexedDataset:
     def __getitem__(self, i: int) -> np.ndarray:
         if isinstance(i, slice):
             return [self[j] for j in range(*i.indices(len(self)))]
+        n = len(self)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(f"document {i} out of range [0, {n})")
         lo, hi = int(self._offsets[i]), int(self._offsets[i + 1])
         return self._data[lo:hi]
 

@@ -45,12 +45,19 @@ class Eigenvalue:
                       for k, l in zip(keys, leaves)])
         v, _ = _normalize(v)
 
-        def body(carry, _):
-            v, prev = carry
+        def cond(carry):
+            _, prev, cur, it = carry
+            return (it < self.max_iter) & \
+                (jnp.abs(cur - prev) > self.tol * jnp.maximum(
+                    jnp.abs(cur), 1e-12))
+
+        def body(carry):
+            v, _, cur, it = carry
             hv = hvp(v)
             v_new, norm = _normalize(hv)
-            return (v_new, norm), norm
+            return (v_new, cur, norm, it + 1)
 
-        (v, eig), _ = jax.lax.scan(
-            body, (v, jnp.zeros(())), None, length=self.max_iter)
+        v, _, eig, _ = jax.lax.while_loop(
+            cond, body, (v, jnp.asarray(-1.0), jnp.zeros(()),
+                         jnp.zeros((), jnp.int32)))
         return eig + self.stability, v

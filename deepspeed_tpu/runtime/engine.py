@@ -451,6 +451,8 @@ class DeepSpeedEngine:
         return new_state, metrics
 
     def _build_train_step(self):
+        if self.optimizer.hyperparams.get("onebit"):
+            return self._build_onebit_train_step()
         gas = self.gradient_accumulation_steps
 
         def step_fn(state, batch):
@@ -462,6 +464,94 @@ class DeepSpeedEngine:
 
         with self.mesh:
             self._train_step_fn = jax.jit(step_fn, donate_argnums=(0,))
+        return self._train_step_fn
+
+    # ------------------------------------------------------------------
+    # 1-bit Adam: shard_map'd step over the compression axis
+    # ------------------------------------------------------------------
+    def _build_onebit_train_step(self):
+        """Compiled step for 1-bit optimizers. Grads stay LOCAL to each
+        ``comm_axis`` replica (partial-manual shard_map; other axes remain
+        GSPMD-auto); the optimizer owns the cross-replica reduction —
+        full-precision pmean in warmup, error-compensated 1-bit allreduce
+        of the momentum in compression (reference fp16/onebit/adam.py;
+        nothing reduces grads twice). Rebuilt at the freeze boundary."""
+        from jax.sharding import PartitionSpec as P
+        opt = self.optimizer
+        axis = opt.comm_axis
+        gas = self.gradient_accumulation_steps
+        w = self.mesh.shape.get(axis, 1)
+        if self.fp16_enabled:
+            raise NotImplementedError(
+                "1-bit Adam with fp16 loss scaling is not wired; use bf16")
+        if self._config.gradient_clipping:
+            logger.warning(
+                "gradient_clipping is ignored by the 1-bit optimizer "
+                "(momentum, not gradients, is communicated — same "
+                "restriction as the reference)")
+        compression = self.global_steps >= opt.freeze_step
+        self._onebit_phase = compression
+        if getattr(self, "_onebit_errors", None) is None:
+            def espec(leaf):
+                return P(axis, *([None] * (leaf.ndim - 1)))
+            with self.mesh:
+                errs = jax.jit(
+                    lambda: opt.init_errors(self._param_shapes, w))()
+            shardings = jax.tree_util.tree_map(
+                lambda l: NamedSharding(self.mesh, espec(l)), errs)
+            self._onebit_errors = jax.device_put(errs, shardings)
+
+        def core(state, errors, batch):
+            gsum, lsum = self._accumulate_micro_grads(
+                state, batch, jnp.asarray(1.0, jnp.float32))
+            grads = jax.tree_util.tree_map(lambda g: g / gas, gsum)
+            lr = self.lr_schedule(state["step"])
+            if compression:
+                new_params, new_opt, new_errors = opt.compression_apply(
+                    grads, state["opt"], state["params"], lr, errors)
+            else:
+                new_params, new_opt = opt.apply(
+                    grads, state["opt"], state["params"], lr)
+                new_errors = errors
+            new_state = {"step": state["step"] + 1,
+                         "skipped": state["skipped"],
+                         "params": new_params, "opt": new_opt}
+            loss = jax.lax.pmean(lsum, axis) / gas
+            gnorm = jax.lax.pmean(global_norm(grads), axis)
+            return new_state, new_errors, {"loss": loss, "grad_norm": gnorm,
+                                           "lr": lr,
+                                           "overflow": jnp.zeros((),
+                                                                 jnp.int32),
+                                           "loss_scale": jnp.asarray(
+                                               1.0, jnp.float32)}
+
+        state_specs = jax.tree_util.tree_map(lambda _: P(),
+                                             self.state_specs())
+        err_in = jax.tree_util.tree_map(
+            lambda l: P(axis), self._onebit_errors)
+
+        def step_fn(state, errors, batch):
+            bspec = jax.tree_util.tree_map(lambda _: P(None, axis), batch)
+            sharded = jax.shard_map(
+                core, mesh=self.mesh,
+                in_specs=(state_specs, err_in, bspec),
+                out_specs=(state_specs, err_in,
+                           jax.tree_util.tree_map(lambda _: P(),
+                                                  {"loss": 0, "grad_norm": 0,
+                                                   "lr": 0, "overflow": 0,
+                                                   "loss_scale": 0})),
+                axis_names={axis}, check_vma=False)
+            return sharded(state, errors, batch)
+
+        with self.mesh:
+            compiled = jax.jit(step_fn, donate_argnums=(0, 1))
+
+        def run(state, batch):
+            new_state, self._onebit_errors, metrics = compiled(
+                state, self._onebit_errors, batch)
+            return new_state, metrics
+
+        self._train_step_fn = run
         return self._train_step_fn
 
     # ------------------------------------------------------------------
@@ -514,6 +604,11 @@ class DeepSpeedEngine:
                 self._step_times.append(time.perf_counter() - t0)
             self._post_step_observe(metrics, batch)
             return metrics
+        if self.optimizer.hyperparams.get("onebit") and \
+                getattr(self, "_onebit_phase", None) is not None and \
+                self._onebit_phase != (
+                    self.global_steps >= self.optimizer.freeze_step):
+            self._train_step_fn = None    # warmup→compression: new program
         if self._train_step_fn is None:
             self._build_train_step()
         if any(not isinstance(v, jax.Array) for v in

@@ -1,0 +1,5 @@
+"""1-bit optimizers — counterpart of
+`/root/reference/deepspeed/runtime/fp16/onebit/`."""
+from .adam import OnebitOptimizer, get_onebit_optimizer, onebit_adam
+
+__all__ = ["onebit_adam", "get_onebit_optimizer", "OnebitOptimizer"]

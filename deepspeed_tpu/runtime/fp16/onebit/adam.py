@@ -1,0 +1,139 @@
+"""1-bit Adam (and 0/1-Adam variant hooks).
+
+Role-equivalent of the reference ``OnebitAdam``
+(`/root/reference/deepspeed/runtime/fp16/onebit/adam.py:11`): exact Adam
+with full-precision gradient averaging during the warmup phase
+(``freeze_step`` steps); afterwards the variance term freezes and the
+MOMENTUM is averaged across replicas through the error-compensated 1-bit
+collective (`runtime/comm/compressed.py`) instead of the gradients —
+cutting inter-replica traffic ~26x on the slow (DCN) axis.
+
+Functional shape: both phases are pure apply functions meant to run inside
+`shard_map` manual over ``comm_axis``; the engine compiles one program per
+phase and switches at the freeze boundary (the reference flips a flag on
+the same optimizer object; a phase here is a different compiled step).
+
+State layout (per param leaf): m/v fp32 replicated; worker_error shaped
+like the leaf and server_error shaped [numel/w] are PER-REPLICA (the engine
+gives them a leading sharded axis).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ...comm.compressed import compressed_allreduce
+from ...optimizers import Optimizer, _tmap, _unzip, _zeros_like_f32
+
+
+@dataclasses.dataclass(frozen=True)
+class OnebitOptimizer(Optimizer):
+    """Optimizer + the compression-phase apply and error-buffer factory."""
+    compression_apply: Any = None
+    init_errors: Any = None
+    freeze_step: int = 100
+    comm_axis: str = "dcn_data"
+    variant: str = "onebitadam"
+
+
+def onebit_adam(lr_default: float = 1e-3, betas=(0.9, 0.999),
+                eps: float = 1e-8, weight_decay: float = 0.0,
+                freeze_step: int = 100,
+                comm_axis: str = "dcn_data",
+                variant: str = "onebitadam") -> OnebitOptimizer:
+    b1, b2 = betas
+
+    def init(params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "m": _zeros_like_f32(params),
+                "v": _zeros_like_f32(params)}
+
+    def init_errors(params, world: int):
+        """Per-replica error-feedback buffers (leading axis = world)."""
+        def we(p):
+            return jnp.zeros((world,) + p.shape, jnp.float32)
+
+        def se(p):
+            n = int(p.size)
+            if n % world:
+                raise ValueError(
+                    f"param numel {n} must divide by world {world} for "
+                    f"1-bit chunking (pad or keep {comm_axis}=1)")
+            return jnp.zeros((world, n // world), jnp.float32)
+        return {"worker": _tmap(we, params), "server": _tmap(se, params)}
+
+    def _update(m, v_used, p, lr):
+        u = m / (jnp.sqrt(v_used) + eps)
+        p32 = p.astype(jnp.float32)
+        if weight_decay:
+            u = u + weight_decay * p32
+        return (p32 - lr * u).astype(p.dtype)
+
+    def warmup_apply(grads, state, params, lr):
+        """Exact Adam; grads averaged across comm_axis in full precision
+        (reference warmup: comm happens outside, here it's explicit)."""
+        step = state["step"] + 1
+
+        def upd(g, m, v, p):
+            g32 = jax.lax.pmean(g.astype(jnp.float32), comm_axis)
+            m_new = b1 * m + (1 - b1) * g32
+            v_new = b2 * v + (1 - b2) * g32 * g32
+            return _update(m_new, v_new, p, lr), m_new, v_new
+
+        out = _tmap(upd, grads, state["m"], state["v"], params)
+        new_params, new_m, new_v = _unzip(out, 3)
+        return new_params, {"step": step, "m": new_m, "v": new_v}
+
+    def compression_apply(grads, state, params, lr, errors):
+        """Frozen-variance phase: local momentum update, then 1-bit
+        error-compensated allreduce of the momentum (reference
+        onebit/adam.py compression path)."""
+        step = state["step"] + 1
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat = {
+            "m": jax.tree_util.tree_leaves(state["m"]),
+            "v": jax.tree_util.tree_leaves(state["v"]),
+            "p": jax.tree_util.tree_leaves(params),
+            "we": jax.tree_util.tree_leaves(errors["worker"]),
+            "se": jax.tree_util.tree_leaves(errors["server"]),
+        }
+        out_p, out_m, out_we, out_se = [], [], [], []
+        for g, m, v, p, we, se in zip(flat_g, flat["m"], flat["v"],
+                                      flat["p"], flat["we"], flat["se"]):
+            m_local = b1 * m + (1 - b1) * g.astype(jnp.float32)
+            m_comm, we2, se2 = compressed_allreduce(
+                m_local, we[0], se[0], comm_axis)
+            out_m.append(m_comm)
+            out_we.append(we2[None])
+            out_se.append(se2[None])
+            out_p.append(_update(m_comm, v, p, lr))
+        unf = lambda ls: jax.tree_util.tree_unflatten(treedef, ls)  # noqa
+        return (unf(out_p),
+                {"step": step, "m": unf(out_m), "v": state["v"]},
+                {"worker": unf(out_we), "server": unf(out_se)})
+
+    return OnebitOptimizer(
+        name=variant, init=init, apply=warmup_apply,
+        hyperparams=dict(lr=lr_default, betas=betas, eps=eps,
+                         weight_decay=weight_decay,
+                         freeze_step=freeze_step, onebit=True),
+        compression_apply=compression_apply, init_errors=init_errors,
+        freeze_step=freeze_step, comm_axis=comm_axis, variant=variant)
+
+
+def get_onebit_optimizer(name: str, lr=None, betas=(0.9, 0.999), **params):
+    """Registry hook for runtime/optimizers.py get_optimizer."""
+    name_l = name.lower().replace("_", "")
+    if name_l not in ("onebitadam", "zerooneadam", "onebitlamb"):
+        raise ValueError(f"unknown onebit optimizer {name}")
+    if name_l == "onebitlamb":
+        raise NotImplementedError(
+            "onebit_lamb is not implemented yet — use onebit_adam")
+    return onebit_adam(
+        lr if lr is not None else 1e-3, tuple(betas),
+        params.pop("eps", 1e-8), params.pop("weight_decay", 0.0),
+        params.pop("freeze_step", 100),
+        params.pop("comm_axis", "dcn_data"), variant=name_l)

@@ -295,7 +295,7 @@ class TestRuntimeExtras:
 
         def loss(p, _b):
             return 0.5 * p["x"] @ a @ p["x"]
-        eig, _ = Eigenvalue(max_iter=50).compute_eigenvalue(
+        eig, _ = Eigenvalue(max_iter=100, tol=1e-6).compute_eigenvalue(
             loss, {"x": jnp.ones(3)}, None)
         np.testing.assert_allclose(float(eig), 5.0, rtol=1e-3)
 
