@@ -187,6 +187,18 @@ def embedding_apply(params, ids, dtype=None):
     return jnp.take(emb, ids, axis=0)
 
 
+def embedding_apply_onehot(params, ids, dtype=None):
+    """Embedding lookup as one_hot @ table — the gather-free form that
+    GSPMD can partition when the vocab dim is sharded (TP embeddings under
+    manual collectives; the reference shards embeddings the same way via
+    VocabParallelEmbedding-style masking)."""
+    emb = params["embedding"]
+    if dtype is not None:
+        emb = emb.astype(dtype)
+    oh = jax.nn.one_hot(ids, emb.shape[0], dtype=emb.dtype)
+    return jnp.einsum("...v,vd->...d", oh, emb)
+
+
 def embedding_attend(params, x):
     """Tied-softmax projection: x @ embedding.T — bf16 operands, fp32
     accumulation (logits come out fp32 without a fp32 matmul)."""

@@ -181,6 +181,9 @@ class PipelineConfig(ConfigModel):
     pipe_partitioned: bool = True
     grad_partitioned: bool = True
     micro_batches: Optional[int] = None
+    # compiled-schedule selection: auto = 1F1B for dense models, gpipe for
+    # MoE (whose aux-loss plumbing lives in the gpipe loss)
+    schedule: str = "auto"   # auto | 1f1b | gpipe
 
 
 class SequenceParallelConfig(ConfigModel):

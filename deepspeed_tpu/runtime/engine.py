@@ -517,7 +517,10 @@ class DeepSpeedEngine:
                          "skipped": state["skipped"],
                          "params": new_params, "opt": new_opt}
             loss = jax.lax.pmean(lsum, axis) / gas
-            gnorm = jax.lax.pmean(global_norm(grads), axis)
+            # the norm Adam actually consumes: of the AVERAGED gradient
+            # (pmean of local norms would overstate it)
+            gnorm = global_norm(jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, axis), grads))
             return new_state, new_errors, {"loss": loss, "grad_norm": gnorm,
                                            "lr": lr,
                                            "overflow": jnp.zeros((),

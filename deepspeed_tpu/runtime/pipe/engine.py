@@ -77,22 +77,25 @@ class PipelinedLM:
             lambda x: x.reshape((-1,) + x.shape[2:]), params["blocks"])
         return params
 
+    # set by PipelineEngine: vocab-sharded embeddings via one-hot matmuls
+    # (gather on a sharded table crashes the SPMD partitioner inside the
+    # partial-manual shard_map; the matmul form partitions cleanly)
+    use_onehot_embed = False
+
     def partition_specs(self):
         specs = dict(self.model.partition_specs())
         specs["blocks"] = jax.tree_util.tree_map(
             lambda sp: P("pipe", *sp), specs["blocks"],
             is_leaf=lambda x: isinstance(x, P))
-        # Embedding gathers on a vocab-sharded table inside the partial-manual
-        # shard_map trip an XLA SPMD-partitioner crash (gather partitioning);
-        # replicate the (tied) embedding over `model` under pipeline until a
-        # one-hot-matmul TP embedding lands.
-        specs["embed"] = jax.tree_util.tree_map(
-            lambda sp: P(*([None] * len(sp))), specs["embed"],
-            is_leaf=lambda x: isinstance(x, P))
-        if "lm_head" in specs:
-            specs["lm_head"] = jax.tree_util.tree_map(
-                lambda sp: P(*([None] * len(sp))), specs["lm_head"],
+        if not self.use_onehot_embed:
+            # no TP: replicate embed/head over `model` (nothing to shard)
+            specs["embed"] = jax.tree_util.tree_map(
+                lambda sp: P(*([None] * len(sp))), specs["embed"],
                 is_leaf=lambda x: isinstance(x, P))
+            if "lm_head" in specs:
+                specs["lm_head"] = jax.tree_util.tree_map(
+                    lambda sp: P(*([None] * len(sp))), specs["lm_head"],
+                    is_leaf=lambda x: isinstance(x, P))
         return specs
 
     def pipe_specs(self):
@@ -112,21 +115,48 @@ class PipelineEngine(DeepSpeedEngine):
     """Engine whose train step runs the compiled pipeline schedule.
 
     ``gradient_accumulation_steps`` is the microbatch count M (same meaning
-    as the reference's engine: train_batch = micro * M * dp)."""
+    as the reference's engine: train_batch = micro * M * dp).
+
+    Two compiled schedules:
+      - ``1f1b`` (default, dense models): the reference TrainSchedule
+        (`schedule.py:182`) as ONE scan over 2(M+S-1) combined ticks —
+        forward at tick 2m+s, backward at tick 2m+2S-1-s (closed forms of
+        the even/odd instruction math, pinned by a validation test).
+        Backward is hand-orchestrated jax.vjp per stage from a ring buffer
+        of ≤ S+1 stored stage inputs, so activation memory is bounded by
+        the in-flight microbatch count — the point of 1F1B — instead of
+        the full schedule length.
+      - ``gpipe``: fill-drain forward scan with autodiff backward (kept
+        for MoE models, whose aux-loss plumbing lives there).
+    """
 
     def __init__(self, model, config=None, mesh=None, **kw):
+        from ..config import DeepSpeedConfig
+        config = (config if isinstance(config, DeepSpeedConfig)
+                  else DeepSpeedConfig(config or {}))
         if mesh is None:
-            from ..config import DeepSpeedConfig
-            cfg = (config if isinstance(config, DeepSpeedConfig)
-                   else DeepSpeedConfig(config or {}))
-            config = cfg
-            mesh = topo.build_mesh(cfg.mesh)
+            mesh = topo.build_mesh(config.mesh)
         if topo.pp_world_size(mesh) < 2:
             raise ValueError("PipelineEngine needs a mesh with pipe>=2")
         self.num_stages = topo.pp_world_size(mesh)
         adapter = model if isinstance(model, PipelinedLM) else PipelinedLM(
             model, self.num_stages)
+        adapter.use_onehot_embed = topo.mp_world_size(mesh) > 1
         self.adapter = adapter
+        self.schedule = config.pipeline.schedule
+        if self.schedule == "auto":
+            # MoE aux-loss plumbing lives in the gpipe loss; dense → 1F1B
+            self.schedule = ("gpipe" if getattr(adapter.config,
+                                                "moe_enabled", False)
+                             else "1f1b")
+        if self.schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"pipeline.schedule must be auto|1f1b|gpipe, "
+                             f"got {self.schedule}")
+        if self.schedule == "1f1b" and getattr(adapter.config,
+                                               "moe_enabled", False):
+            raise NotImplementedError(
+                "1f1b schedule does not carry the MoE aux loss yet; use "
+                "pipeline.schedule=gpipe for MoE models")
         mcfg = adapter.config
         if getattr(mcfg, "attn_impl", None) == "ring":
             raise NotImplementedError(
@@ -160,8 +190,12 @@ class PipelineEngine(DeepSpeedEngine):
         norm = (L.layernorm_apply if cfg.norm_type == "layernorm"
                 else L.rmsnorm_apply)
 
+        onehot = getattr(self.adapter, "use_onehot_embed", False)
+
         def embed_fn(tok):
-            x = L.embedding_apply(params["embed"], tok, cfg.dtype)
+            embed = (L.embedding_apply_onehot if onehot
+                     else L.embedding_apply)
+            x = embed(params["embed"], tok, cfg.dtype)
             if cfg.pos_embedding == "learned":
                 pos = jnp.arange(t)[None, :]
                 x = x + L.embedding_apply(params["pos_embed"], pos, cfg.dtype)
@@ -188,8 +222,13 @@ class PipelineEngine(DeepSpeedEngine):
                 xc, yc, mc = xs
                 logits = model._project(params, xc)
                 lse = jax.scipy.special.logsumexp(logits, axis=-1)
-                tgt = jnp.take_along_axis(logits, yc[..., None],
-                                          axis=-1)[..., 0]
+                if onehot:   # sharded-vocab-safe target extraction
+                    tgt = jnp.sum(
+                        logits * jax.nn.one_hot(yc, logits.shape[-1],
+                                                dtype=logits.dtype), -1)
+                else:
+                    tgt = jnp.take_along_axis(logits, yc[..., None],
+                                              axis=-1)[..., 0]
                 tot, cnt2 = carry
                 return (tot + jnp.sum((lse - tgt) * mc),
                         cnt2 + jnp.sum(mc)), None
@@ -254,7 +293,235 @@ class PipelineEngine(DeepSpeedEngine):
             loss = loss + cfg.moe_aux_loss_coef * laux
         return loss
 
+    # ------------------------------------------------------------------
+    # 1F1B: one compiled scan over combined fwd/bwd ticks
+    # ------------------------------------------------------------------
+    def _pipeline_value_and_grad(self, params, ids, scale):
+        """Manual over 'pipe'. ids [M, mb, T]; params in compute dtype.
+        Returns (loss summed over microbatches, grads summed over
+        microbatches x ``scale``) — backward is hand-driven jax.vjp per
+        stage, activations bounded by a ring of S+1 stored stage inputs.
+
+        Tick timing (validated against TrainSchedule, test_pipeline.py):
+            forward  of microbatch m at stage s: tick 2m + s
+            backward of microbatch m at stage s: tick 2m + 2S - 1 - s
+        Activations ppermute forward each tick, cotangents backward; both
+        are consumed exactly one tick after production.
+        """
+        cfg = self.adapter.config
+        model = self.adapter.model
+        s = self.num_stages
+        sid = jax.lax.axis_index(topo.PIPE_AXIS)
+        m, mb, t = ids.shape
+        cap = s + 1                      # ring capacity ≥ in-flight bound
+        onehot = getattr(self.adapter, "use_onehot_embed", False)
+        norm = (L.layernorm_apply if cfg.norm_type == "layernorm"
+                else L.rmsnorm_apply)
+        norm = partial(norm, eps=cfg.layernorm_eps)
+
+        blocks_local = jax.tree_util.tree_map(lambda x: x[0],
+                                              params["blocks"])
+        eparams = {"embed": params["embed"]}
+        if "pos_embed" in params:
+            eparams["pos_embed"] = params["pos_embed"]
+        tied = "lm_head" not in params
+        hparams = {"ln_f": params["ln_f"],
+                   ("embed" if tied else "lm_head"):
+                       params["embed" if tied else "lm_head"]}
+
+        def embed_fn(ep, tok):
+            embed = (L.embedding_apply_onehot if onehot
+                     else L.embedding_apply)
+            x = embed(ep["embed"], tok, cfg.dtype)
+            if cfg.pos_embedding == "learned":
+                pos = jnp.arange(t)[None, :]
+                x = x + L.embedding_apply(ep["pos_embed"], pos, cfg.dtype)
+            return x
+
+        def stage_fn(bl, x):
+            def f(c, bp):
+                y, _ = model._block(bp, c)
+                return y, None
+            y, _ = jax.lax.scan(f, x, bl)
+            return y
+
+        chunk = cfg.loss_chunk if (cfg.loss_chunk and
+                                   t % max(cfg.loss_chunk, 1) == 0 and
+                                   t > cfg.loss_chunk) else t
+
+        def head_fn(hp, y, tok):
+            """Per-microbatch mean CE (chunked; sharded-vocab safe).
+            NOTE: mirrors _pipeline_loss.head_loss (gpipe) — the two
+            schedules must stay numerically identical
+            (test_gpipe_schedule_matches_1f1b pins them)."""
+            x = norm(hp["ln_f"], y)
+            labels = jnp.concatenate(
+                [tok[:, 1:], jnp.zeros_like(tok[:, :1])], axis=1)
+            mask = jnp.ones((mb, t), jnp.float32).at[:, -1].set(0.0)
+            n_chunks = t // chunk
+
+            def proj(xc):
+                if tied:
+                    return L.embedding_attend(hp["embed"], xc)
+                return jnp.einsum("...d,dv->...v", xc,
+                                  hp["lm_head"]["kernel"].astype(xc.dtype),
+                                  preferred_element_type=jnp.float32)
+
+            def to_chunks(a):
+                return a.reshape(mb, n_chunks, chunk,
+                                 *a.shape[2:]).swapaxes(0, 1)
+
+            def body(carry, xs):
+                xc, yc, mc = xs
+                logits = proj(xc)
+                lse = jax.scipy.special.logsumexp(logits, axis=-1)
+                if onehot:
+                    tgt = jnp.sum(logits * jax.nn.one_hot(
+                        yc, logits.shape[-1], dtype=logits.dtype), -1)
+                else:
+                    tgt = jnp.take_along_axis(logits, yc[..., None],
+                                              axis=-1)[..., 0]
+                tot, cnt = carry
+                return (tot + jnp.sum((lse - tgt) * mc),
+                        cnt + jnp.sum(mc)), None
+
+            (tot, cnt), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32),
+                       jnp.zeros((), jnp.float32)),
+                (to_chunks(x), to_chunks(labels), to_chunks(mask)))
+            return tot / jnp.maximum(cnt, 1.0)
+
+        perm_f = [(i, (i + 1) % s) for i in range(s)]
+        perm_b = [(i, (i - 1) % s) for i in range(s)]
+        f32 = jnp.float32
+
+        def zeros_f32(tree):
+            return jax.tree_util.tree_map(
+                lambda x: jnp.zeros(x.shape, f32), tree)
+
+        def tick(carry, tt):
+            act, cot, buf, g_bl, g_e, g_h, lsum = carry
+            recv_act = jax.lax.ppermute(act, topo.PIPE_AXIS, perm_f)
+            recv_cot = jax.lax.ppermute(cot, topo.PIPE_AXIS, perm_b)
+
+            # ---- forward part: microbatch (tt - sid)/2 ------------------
+            mf2 = tt - sid
+            mf = jnp.clip(mf2 // 2, 0, m - 1)
+            fvalid = (mf2 % 2 == 0) & (mf2 >= 0) & (mf2 // 2 < m)
+            # embed only where it's real work: stage 0's valid fwd ticks
+            # (under TP the one-hot embed is an mb·t·V·d matmul)
+            x_in = jax.lax.cond(
+                fvalid & (sid == 0),
+                lambda: embed_fn(eparams, ids[mf]), lambda: recv_act)
+            slot = mf % cap
+            old = jax.lax.dynamic_index_in_dim(buf, slot, 0,
+                                               keepdims=False)
+            buf = jax.lax.dynamic_update_index_in_dim(
+                buf, jnp.where(fvalid, x_in, old), slot, 0)
+            # last stage never forwards its output anywhere; skip compute
+            new_act = jax.lax.cond(
+                fvalid & (sid < s - 1),
+                lambda: stage_fn(blocks_local, x_in), lambda: act)
+
+            # ---- backward part: microbatch (tt - (2S-1-sid))/2 ----------
+            mb2 = tt - (2 * s - 1 - sid)
+            mbk = jnp.clip(mb2 // 2, 0, m - 1)
+            bvalid = (mb2 % 2 == 0) & (mb2 >= 0) & (mb2 // 2 < m)
+            x_st = jax.lax.dynamic_index_in_dim(buf, mbk % cap, 0,
+                                                keepdims=False)
+            tok_b = ids[mbk]
+
+            def bwd_last():
+                lossv, vjp = jax.vjp(
+                    lambda x, bl, hp: head_fn(hp, stage_fn(bl, x), tok_b),
+                    x_st, blocks_local, hparams)
+                dx, dbl, dhp = vjp(jnp.asarray(scale, f32))
+                return dx, dbl, dhp, lossv
+
+            def bwd_mid():
+                _, vjp = jax.vjp(lambda x, bl: stage_fn(bl, x),
+                                 x_st, blocks_local)
+                dx, dbl = vjp(recv_cot)
+                return (dx, dbl,
+                        jax.tree_util.tree_map(jnp.zeros_like, hparams),
+                        jnp.zeros((), f32))
+
+            def bwd_skip():
+                return (jnp.zeros_like(act),
+                        jax.tree_util.tree_map(jnp.zeros_like,
+                                               blocks_local),
+                        jax.tree_util.tree_map(jnp.zeros_like, hparams),
+                        jnp.zeros((), f32))
+
+            dx, dbl, dhp, lossv = jax.lax.cond(
+                bvalid,
+                lambda: jax.lax.cond(sid == s - 1, bwd_last, bwd_mid),
+                bwd_skip)
+
+            dep = jax.lax.cond(
+                bvalid & (sid == 0),
+                lambda: jax.vjp(lambda ep: embed_fn(ep, tok_b),
+                                eparams)[1](dx)[0],
+                lambda: jax.tree_util.tree_map(jnp.zeros_like, eparams))
+
+            g_bl = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(f32)[None], g_bl, dbl)
+            g_e = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(f32), g_e, dep)
+            g_h = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(f32), g_h, dhp)
+            return (new_act, dx, buf, g_bl, g_e, g_h, lsum + lossv), None
+
+        act0 = jnp.zeros((mb, t, cfg.d_model), cfg.dtype)
+        buf0 = jnp.zeros((cap, mb, t, cfg.d_model), cfg.dtype)
+        carry0 = (act0, act0, buf0,
+                  zeros_f32(params["blocks"]), zeros_f32(eparams),
+                  zeros_f32(hparams), jnp.zeros((), f32))
+        (_, _, _, g_bl, g_e, g_h, lsum), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(2 * (m + s - 1)))
+
+        psum = partial(jax.lax.psum, axis_name=topo.PIPE_AXIS)
+        loss = psum(lsum)                      # last stage only
+        grads = {"blocks": g_bl}               # stays pipe-local
+        g_e = jax.tree_util.tree_map(psum, g_e)     # stage 0 only
+        g_h = jax.tree_util.tree_map(psum, g_h)     # last stage only
+        grads["ln_f"] = g_h["ln_f"]
+        if tied:
+            grads["embed"] = jax.tree_util.tree_map(
+                jnp.add, g_e["embed"], g_h["embed"])
+        else:
+            grads["embed"] = g_e["embed"]
+            grads["lm_head"] = g_h["lm_head"]
+        if "pos_embed" in g_e:
+            grads["pos_embed"] = g_e["pos_embed"]
+        return loss, grads
+
+    def _build_1f1b_train_step(self):
+        pipe_specs = self.adapter.pipe_specs()
+        grad_out_specs = pipe_specs   # same tree/layout as the params
+        sharded = jax.shard_map(
+            self._pipeline_value_and_grad, mesh=self.mesh,
+            in_specs=(pipe_specs, P(), P()),
+            out_specs=(P(), grad_out_specs),
+            axis_names={topo.PIPE_AXIS}, check_vma=False)
+        n_micro = float(self.micro_batches)
+
+        def step_fn(state, batch):
+            ids = batch["input_ids"]        # [M, mb, T]
+            scale = self._current_scale(state)
+            loss_sum, grads = sharded(
+                self._cast_for_compute(state["params"]), ids, scale)
+            new_state, metrics = self._apply_grads(state, grads, n_micro)
+            metrics["loss"] = loss_sum / n_micro
+            return new_state, metrics
+
+        with self.mesh:
+            self._train_step_fn = jax.jit(step_fn, donate_argnums=(0,))
+        return self._train_step_fn
+
     def _build_train_step(self):
+        if self.schedule == "1f1b":
+            return self._build_1f1b_train_step()
         auto_axes = frozenset(a for a in self.mesh.axis_names
                               if a != topo.PIPE_AXIS)
         pipe_specs = self.adapter.pipe_specs()

@@ -174,7 +174,7 @@ class TrainSchedule(PipeSchedule):
 
     @property
     def num_pipe_buffers(self):
-        buffers = min(self.stages - self.stage_id, self.micro_batches)
+        buffers = min(self.stages - self.stage_id + 1, self.micro_batches)
         return max(2, buffers)
 
     def _step_to_micro_batch(self, step_id):
@@ -196,11 +196,10 @@ class TrainSchedule(PipeSchedule):
         return (step_id - 1) // 2 - self.stage_id // 2
 
     def _even_step_backward_id(self, step_id):
-        return step_id // 2 - self.stages + (self.stage_id + 1) // 2 + 1
+        return step_id // 2 - self.stages + (self.stage_id + 1) // 2
 
     def _odd_step_backward_id(self, step_id):
-        return ((step_id - 1) // 2 - self.stages + (self.stage_id + 1) // 2
-                + 1)
+        return (step_id - 1) // 2 - self.stages + 1 + self.stage_id // 2
 
     def _buffer_idx(self, micro_batch_id):
         return micro_batch_id % self.num_pipe_buffers

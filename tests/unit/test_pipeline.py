@@ -67,6 +67,41 @@ class TestSchedules:
         s = "".join(kinds)
         assert "FBFB" in s  # alternation appears in steady state
 
+    @pytest.mark.parametrize("m,stages", [(4, 2), (6, 4), (8, 3), (3, 3)])
+    def test_compiled_loop_timing_matches_schedule(self, m, stages):
+        """The compiled 1F1B loop's closed-form tick mapping (fwd at
+        2m+s, bwd at 2m+2S-1-s) must reproduce the TrainSchedule
+        instruction simulation exactly — the validation the schedule
+        docstring promises."""
+        for sid in range(stages):
+            sched = S.TrainSchedule(micro_batches=m, stages=stages,
+                                    stage_id=sid)
+            sim = {}
+            for t in range(2 * (m + stages - 1)):
+                mb_id, fwd = sched._step_to_micro_batch(t)
+                if sched._valid_micro_batch(mb_id):
+                    sim[(t, "F" if fwd else "B")] = mb_id
+            compiled = {}
+            for t in range(2 * (m + stages - 1)):
+                mf2 = t - sid
+                if mf2 >= 0 and mf2 % 2 == 0 and mf2 // 2 < m:
+                    compiled[(t, "F")] = mf2 // 2
+                mb2 = t - (2 * stages - 1 - sid)
+                if mb2 >= 0 and mb2 % 2 == 0 and mb2 // 2 < m:
+                    compiled[(t, "B")] = mb2 // 2
+            assert compiled == sim, (sid, compiled, sim)
+
+    def test_ordering_invariants(self):
+        """Backward of m at stage s must come after forward of m at s and
+        after backward of m at stage s+1 (grad flow feasibility)."""
+        for stages in (2, 3, 4):
+            for m in range(6):
+                for s in range(stages):
+                    tf, tb = 2 * m + s, 2 * m + 2 * stages - 1 - s
+                    assert tb > tf
+                    if s + 1 < stages:
+                        assert tb > 2 * m + 2 * stages - 1 - (s + 1)
+
 
 class TestPartitioning:
     def test_uniform(self):
@@ -159,6 +194,41 @@ class TestPipelineEngine:
         # scale=1 vs scale=256 must trace the same trajectory; a missing
         # scale multiply shows up as a 256x-smaller update by step 2.
         np.testing.assert_allclose(losses[0], losses[8], rtol=5e-3)
+
+    def test_gpipe_schedule_matches_1f1b(self):
+        """Both compiled schedules are the same math — losses must agree
+        (and both match DP, transitively)."""
+        mesh_conf = {"pipe": 2, "data": 4}
+        mesh = build_mesh(MeshConfig(**mesh_conf))
+        out = {}
+        for sched in ("gpipe", "1f1b"):
+            cfgd = base_config(pipeline={"schedule": sched})
+            cfgd["mesh"] = mesh_conf
+            engine = PipelineEngine(model=tiny_model(), config=cfgd,
+                                    mesh=mesh, rng=jax.random.PRNGKey(3))
+            assert engine.schedule == sched
+            out[sched] = [float(engine.train_step(
+                fixed_batch(engine.train_batch_size, seed=i))["loss"])
+                for i in range(3)]
+        np.testing.assert_allclose(out["gpipe"], out["1f1b"], rtol=2e-4)
+
+    def test_3d_with_sharded_embeddings(self):
+        """pp x dp x tp with the one-hot TP embedding: the embedding table
+        must actually be SHARDED over 'model' under PP (the BLOOM-3D
+        blocker from round 1)."""
+        mesh_conf = {"pipe": 2, "data": 2, "model": 2}
+        mesh = build_mesh(MeshConfig(**mesh_conf))
+        cfgd = base_config()
+        cfgd["mesh"] = mesh_conf
+        engine = PipelineEngine(model=tiny_model(), config=cfgd,
+                                mesh=mesh, rng=jax.random.PRNGKey(3))
+        emb = engine.state["params"]["embed"]["embedding"]
+        assert "model" in str(emb.sharding.spec), emb.sharding.spec
+        ref = self._dp_reference_losses()
+        pp = [float(engine.train_step(
+            fixed_batch(engine.train_batch_size, seed=i))["loss"])
+            for i in range(3)]
+        np.testing.assert_allclose(ref, pp, rtol=2e-3)
 
     def test_rejects_indivisible_layers(self):
         mesh = build_mesh(MeshConfig(pipe=2, data=4))
