@@ -517,10 +517,12 @@ class DeepSpeedEngine:
                          "skipped": state["skipped"],
                          "params": new_params, "opt": new_opt}
             loss = jax.lax.pmean(lsum, axis) / gas
-            # the norm Adam actually consumes: of the AVERAGED gradient
-            # (pmean of local norms would overstate it)
-            gnorm = global_norm(jax.tree_util.tree_map(
-                lambda g: jax.lax.pmean(g, axis), grads))
+            # observability must not reintroduce the traffic 1-bit removes:
+            # a full-precision pmean of the grad TREE would cost an exact
+            # allreduce per step. Report the mean of per-replica local norms
+            # instead (one scalar on the wire) — an upper bound on the norm
+            # of the averaged gradient, documented as such.
+            gnorm = jax.lax.pmean(global_norm(grads), axis)
             return new_state, new_errors, {"loss": loss, "grad_norm": gnorm,
                                            "lr": lr,
                                            "overflow": jnp.zeros((),

@@ -41,6 +41,43 @@ from ..engine import DeepSpeedEngine, global_norm
 from ..zero.sharding import constrain
 
 
+def chunked_ce(proj, norm_fn, ln_params, y, tok, chunk, onehot):
+    """Shared head loss of BOTH pipeline schedules: final norm + chunked
+    cross-entropy over `chunk`-token slices (the [mb, chunk, V] logits
+    block is the only live vocab tensor). Returns (sum_nll, token_count).
+
+    ``proj``: x → logits; ``onehot``: extract the target logit via a
+    one-hot product instead of take_along_axis (gathers along a
+    vocab-sharded dim crash the SPMD partitioner under manual axes)."""
+    mb, t = tok.shape
+    x = norm_fn(ln_params, y)
+    labels = jnp.concatenate([tok[:, 1:], jnp.zeros_like(tok[:, :1])],
+                             axis=1)
+    mask = jnp.ones((mb, t), jnp.float32).at[:, -1].set(0.0)
+    n_chunks = t // chunk
+
+    def to_chunks(a):
+        return a.reshape(mb, n_chunks, chunk, *a.shape[2:]).swapaxes(0, 1)
+
+    def body(carry, xs):
+        xc, yc, mc = xs
+        logits = proj(xc)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        if onehot:
+            tgt = jnp.sum(logits * jax.nn.one_hot(
+                yc, logits.shape[-1], dtype=logits.dtype), -1)
+        else:
+            tgt = jnp.take_along_axis(logits, yc[..., None],
+                                      axis=-1)[..., 0]
+        tot, cnt = carry
+        return (tot + jnp.sum((lse - tgt) * mc), cnt + jnp.sum(mc)), None
+
+    (tot, cnt), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (to_chunks(x), to_chunks(labels), to_chunks(mask)))
+    return tot, cnt
+
+
 class PipelinedLM:
     """Adapter: stage-stack a TransformerLM's params for pipeline execution.
 
@@ -206,37 +243,9 @@ class PipelineEngine(DeepSpeedEngine):
                                    t > cfg.loss_chunk) else t
 
         def head_loss(y, tok):
-            """Chunked-CE head (same dataflow as TransformerLM.loss: the
-            [mb, chunk, V] logits block is the only live vocab tensor)."""
-            x = norm(params["ln_f"], y, eps=cfg.layernorm_eps)
-            labels = jnp.concatenate(
-                [tok[:, 1:], jnp.zeros_like(tok[:, :1])], axis=1)
-            mask = jnp.ones((mb, t), jnp.float32).at[:, -1].set(0.0)
-            n_chunks = t // chunk
-
-            def to_chunks(a):
-                return a.reshape(mb, n_chunks, chunk,
-                                 *a.shape[2:]).swapaxes(0, 1)
-
-            def body(carry, xs):
-                xc, yc, mc = xs
-                logits = model._project(params, xc)
-                lse = jax.scipy.special.logsumexp(logits, axis=-1)
-                if onehot:   # sharded-vocab-safe target extraction
-                    tgt = jnp.sum(
-                        logits * jax.nn.one_hot(yc, logits.shape[-1],
-                                                dtype=logits.dtype), -1)
-                else:
-                    tgt = jnp.take_along_axis(logits, yc[..., None],
-                                              axis=-1)[..., 0]
-                tot, cnt2 = carry
-                return (tot + jnp.sum((lse - tgt) * mc),
-                        cnt2 + jnp.sum(mc)), None
-
-            (tot, cnt2), _ = jax.lax.scan(
-                body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
-                (to_chunks(x), to_chunks(labels), to_chunks(mask)))
-            return tot, cnt2
+            return chunked_ce(lambda xc: model._project(params, xc),
+                              partial(norm, eps=cfg.layernorm_eps),
+                              params["ln_f"], y, tok, chunk, onehot)
 
         def sb_fn(sp, x):
             y, _, la = model._superblock(sp, x)
@@ -350,45 +359,16 @@ class PipelineEngine(DeepSpeedEngine):
                                    t > cfg.loss_chunk) else t
 
         def head_fn(hp, y, tok):
-            """Per-microbatch mean CE (chunked; sharded-vocab safe).
-            NOTE: mirrors _pipeline_loss.head_loss (gpipe) — the two
-            schedules must stay numerically identical
-            (test_gpipe_schedule_matches_1f1b pins them)."""
-            x = norm(hp["ln_f"], y)
-            labels = jnp.concatenate(
-                [tok[:, 1:], jnp.zeros_like(tok[:, :1])], axis=1)
-            mask = jnp.ones((mb, t), jnp.float32).at[:, -1].set(0.0)
-            n_chunks = t // chunk
-
+            """Per-microbatch MEAN CE via the shared chunked_ce head (the
+            gpipe path consumes the same helper as (sum, count))."""
             def proj(xc):
                 if tied:
                     return L.embedding_attend(hp["embed"], xc)
                 return jnp.einsum("...d,dv->...v", xc,
                                   hp["lm_head"]["kernel"].astype(xc.dtype),
                                   preferred_element_type=jnp.float32)
-
-            def to_chunks(a):
-                return a.reshape(mb, n_chunks, chunk,
-                                 *a.shape[2:]).swapaxes(0, 1)
-
-            def body(carry, xs):
-                xc, yc, mc = xs
-                logits = proj(xc)
-                lse = jax.scipy.special.logsumexp(logits, axis=-1)
-                if onehot:
-                    tgt = jnp.sum(logits * jax.nn.one_hot(
-                        yc, logits.shape[-1], dtype=logits.dtype), -1)
-                else:
-                    tgt = jnp.take_along_axis(logits, yc[..., None],
-                                              axis=-1)[..., 0]
-                tot, cnt = carry
-                return (tot + jnp.sum((lse - tgt) * mc),
-                        cnt + jnp.sum(mc)), None
-
-            (tot, cnt), _ = jax.lax.scan(
-                body, (jnp.zeros((), jnp.float32),
-                       jnp.zeros((), jnp.float32)),
-                (to_chunks(x), to_chunks(labels), to_chunks(mask)))
+            tot, cnt = chunked_ce(proj, norm, hp["ln_f"], y, tok, chunk,
+                                  onehot)
             return tot / jnp.maximum(cnt, 1.0)
 
         perm_f = [(i, (i + 1) % s) for i in range(s)]
