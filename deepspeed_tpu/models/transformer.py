@@ -190,70 +190,90 @@ class TransformerLM:
                 depth_scale=config.num_layers)
 
     # -- init --------------------------------------------------------------
-    def init(self, rng) -> Dict:
-        c = self.config
-        dt = c.param_dtype
-        keys = jax.random.split(rng, 8)
-        d, f, nh, hd = c.d_model, c.ff_dim, c.num_heads, c.hdim
+    # Split into per-piece initializers so streamed-parameter paths
+    # (ZeRO-Infinity, runtime/zero/infinity.py) can materialize one layer at
+    # a time; init() composes them and is bit-identical to the monolithic
+    # form (vmap of init_superblock over split keys == the old stacked init).
+    def _attn_block_init(self, k):
+        c, dt = self.config, self.config.param_dtype
+        d, nh, hd = c.d_model, c.num_heads, c.hdim
         norm_init = (L.layernorm_init if c.norm_type == "layernorm"
                      else L.rmsnorm_init)
+        k1, k2 = jax.random.split(k, 2)
+        blk = {
+            "ln1": norm_init(None, d, dt),
+            "attn": {
+                "qkv": L.dense_init(k1, d, 3 * nh * hd, c.use_bias, 0.02, dt),
+                "out": {"kernel": L.scaled_init(k2, (nh * hd, d), 0.02,
+                                                c.num_layers, dt)},
+            },
+            "ln2": norm_init(None, d, dt),
+        }
+        if c.use_bias:
+            blk["attn"]["out"]["bias"] = jnp.zeros((d,), dt)
+        return blk
 
-        def stack(init_fn, key, n=c.scan_length):
-            ks = jax.random.split(key, n)
-            return jax.vmap(init_fn)(ks)
+    def _block_init(self, k):
+        c, dt = self.config, self.config.param_dtype
+        d, f = c.d_model, c.ff_dim
+        ka, k3, k4 = jax.random.split(k, 3)
+        blk = self._attn_block_init(ka)
+        blk["mlp"] = {
+            "fc_in": L.dense_init(k3, d, f, c.use_bias, 0.02, dt),
+            "fc_out": {"kernel": L.scaled_init(k4, (f, d), 0.02,
+                                               c.num_layers, dt)},
+        }
+        if c.use_bias:
+            blk["mlp"]["fc_out"]["bias"] = jnp.zeros((d,), dt)
+        return blk
 
-        def attn_block_init(k):
-            k1, k2 = jax.random.split(k, 2)
-            blk = {
-                "ln1": norm_init(None, d, dt),
-                "attn": {
-                    "qkv": L.dense_init(k1, d, 3 * nh * hd, c.use_bias, 0.02, dt),
-                    "out": {"kernel": L.scaled_init(k2, (nh * hd, d), 0.02,
-                                                    c.num_layers, dt)},
-                },
-                "ln2": norm_init(None, d, dt),
-            }
-            if c.use_bias:
-                blk["attn"]["out"]["bias"] = jnp.zeros((d,), dt)
-            return blk
+    def _moe_block_init(self, k):
+        dt = self.config.param_dtype
+        ka, km = jax.random.split(k, 2)
+        blk = self._attn_block_init(ka)
+        blk["moe"] = self._moe.init(km, dt)
+        return blk
 
-        def block_init(k):
-            ka, k3, k4 = jax.random.split(k, 3)
-            blk = attn_block_init(ka)
-            blk["mlp"] = {
-                "fc_in": L.dense_init(k3, d, f, c.use_bias, 0.02, dt),
-                "fc_out": {"kernel": L.scaled_init(k4, (f, d), 0.02,
-                                                   c.num_layers, dt)},
-            }
-            if c.use_bias:
-                blk["mlp"]["fc_out"]["bias"] = jnp.zeros((d,), dt)
-            return blk
+    def init_superblock(self, k) -> Dict:
+        """One scanned layer's params (no leading stack axis)."""
+        c = self.config
+        if not c.moe_enabled:
+            return self._block_init(k)
+        if c.moe_freq == 1:
+            return {"moe_blk": self._moe_block_init(k)}
+        kd, km = jax.random.split(k, 2)
+        return {"dense": self._block_init(kd),
+                "moe_blk": self._moe_block_init(km)}
 
-        def moe_block_init(k):
-            ka, km = jax.random.split(k, 2)
-            blk = attn_block_init(ka)
-            blk["moe"] = self._moe.init(km, dt)
-            return blk
+    def superblock_keys(self, rng) -> jax.Array:
+        """Per-layer init keys; layer i of init() == init_superblock(keys[i])."""
+        return jax.random.split(jax.random.split(rng, 8)[1],
+                                self.config.scan_length)
 
-        def superblock_init(k):
-            if not c.moe_enabled:
-                return block_init(k)
-            if c.moe_freq == 1:
-                return {"moe_blk": moe_block_init(k)}
-            kd, km = jax.random.split(k, 2)
-            return {"dense": block_init(kd), "moe_blk": moe_block_init(km)}
-
+    def init_resident(self, rng) -> Dict:
+        """Everything outside the scanned blocks (embeddings, final norm,
+        untied head) — the params a streamed path keeps device-resident."""
+        c, dt = self.config, self.config.param_dtype
+        d = c.d_model
+        norm_init = (L.layernorm_init if c.norm_type == "layernorm"
+                     else L.rmsnorm_init)
+        keys = jax.random.split(rng, 8)
         params = {
             "embed": L.embedding_init(keys[0], c.vocab_size, d, 0.02, dt),
-            "blocks": stack(superblock_init, keys[1]),
             "ln_f": norm_init(None, d, dt),
         }
         if c.pos_embedding == "learned":
             params["pos_embed"] = L.embedding_init(keys[2], c.max_seq_len, d,
                                                    0.01, dt)
         if not c.tie_embeddings:
-            params["lm_head"] = {"kernel": L.normal_init(keys[3], (d, c.vocab_size),
-                                                         0.02, dt)}
+            params["lm_head"] = {"kernel": L.normal_init(
+                keys[3], (d, c.vocab_size), 0.02, dt)}
+        return params
+
+    def init(self, rng) -> Dict:
+        params = self.init_resident(rng)
+        params["blocks"] = jax.vmap(self.init_superblock)(
+            self.superblock_keys(rng))
         return params
 
     def bind_mesh(self, mesh) -> None:

@@ -32,6 +32,11 @@ def _lib():
         ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
         _C_U16]
     lib.ds_f32_to_bf16.argtypes = [ctypes.c_int64, _C_F32, _C_U16]
+    lib.ds_adam_step_g16.argtypes = [
+        ctypes.c_int64, _C_F32, _C_F32, _C_F32, _C_U16,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        ctypes.c_float, ctypes.c_int, ctypes.c_float, ctypes.c_int, _C_U16]
+    lib.ds_accum_g16.argtypes = [ctypes.c_int64, _C_F32, _C_U16]
     return lib
 
 

@@ -1,0 +1,9 @@
+"""Async host file IO for the NVMe offload tier (ZeRO-Infinity).
+
+Reference: `/root/reference/deepspeed/ops/aio/__init__.py` (AsyncIOBuilder).
+"""
+from .aio_handle import (ALIGN, AsyncIOHandle, PinnedBuffer, aio_available,
+                         round_up)
+
+__all__ = ["ALIGN", "AsyncIOHandle", "PinnedBuffer", "aio_available",
+           "round_up"]

@@ -58,6 +58,46 @@ void ds_adam_step(int64_t n, float* p, float* m, float* v, const float* g,
     }
 }
 
+// Adam step with bf16 gradients straight off the wire (the ZeRO-Infinity
+// grad stream is bf16 — converting inline saves a full host pass, which
+// matters on single-core TPU-VM hosts).
+void ds_adam_step_g16(int64_t n, float* p, float* m, float* v,
+                      const uint16_t* g16, float lr, float beta1, float beta2,
+                      float eps, float weight_decay, int step,
+                      float grad_scale, int adamw, uint16_t* out_bf16) {
+    const float c1 = 1.0f - powf(beta1, (float)step);
+    const float c2 = 1.0f - powf(beta2, (float)step);
+    const float inv_scale = 1.0f / grad_scale;
+#pragma omp parallel for simd schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        uint32_t gbits = ((uint32_t)g16[i]) << 16;
+        float grad;
+        std::memcpy(&grad, &gbits, 4);
+        grad *= inv_scale;
+        if (!adamw && weight_decay != 0.0f) grad += weight_decay * p[i];
+        float mi = beta1 * m[i] + (1.0f - beta1) * grad;
+        float vi = beta2 * v[i] + (1.0f - beta2) * grad * grad;
+        m[i] = mi;
+        v[i] = vi;
+        float u = (mi / c1) / (sqrtf(vi / c2) + eps);
+        if (adamw && weight_decay != 0.0f) u += weight_decay * p[i];
+        p[i] -= lr * u;
+        if (out_bf16) out_bf16[i] = f32_to_bf16(p[i]);
+    }
+}
+
+// Accumulate bf16 wire gradients into an fp32 buffer (gradient
+// accumulation across microbatches in the collect path).
+void ds_accum_g16(int64_t n, float* acc, const uint16_t* g16) {
+#pragma omp parallel for simd schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        uint32_t gbits = ((uint32_t)g16[i]) << 16;
+        float grad;
+        std::memcpy(&grad, &gbits, 4);
+        acc[i] += grad;
+    }
+}
+
 // Adagrad step (reference csrc/adagrad/cpu_adagrad.cpp).
 void ds_adagrad_step(int64_t n, float* p, float* sq, const float* g,
                      float lr, float eps, float weight_decay,

@@ -74,6 +74,11 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         state["scaler"] = dict(scaler._asdict())
     ckptr.save(os.path.join(path, "state"), state, force=True)
 
+    if getattr(engine, "_infinity", None) is not None:
+        # ZeRO-Infinity: the entire model lives in the host/NVMe stores —
+        # streamed slot-by-slot into the tag dir (constant memory)
+        engine._infinity.save_to_dir(os.path.join(path, "infinity"))
+
     if getattr(engine, "_host_opt", None) is not None:
         # ZeRO-Offload host state (masters + moments, numpy) — saved
         # synchronously beside the device tree (reference writes these into
@@ -195,7 +200,8 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     target = dict(abstract)
     if scaler_abs is not None:
         target["scaler"] = dict(scaler_abs._asdict())
-    if load_module_only or not load_optimizer_states:
+    if (load_module_only or not load_optimizer_states) \
+            and "params" in target:
         # partial restore: params+step only, fresh optimizer state
         params_target = {"step": target["step"], "params": target["params"]}
         restore_args = ocp.checkpoint_utils.construct_restore_args(
@@ -218,6 +224,17 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         elif "scaler" in restored:
             restored.pop("scaler")
         engine.state = restored
+
+    if getattr(engine, "_infinity", None) is not None:
+        inf_path = os.path.join(path, "infinity")
+        if not os.path.isdir(inf_path):
+            raise FileNotFoundError(
+                f"engine runs ZeRO-Infinity but {inf_path} is missing — "
+                f"this checkpoint was saved by a non-infinity engine")
+        engine._infinity.load_from_dir(
+            inf_path,
+            load_optimizer_states=(load_optimizer_states
+                                   and not load_module_only))
 
     host_path = os.path.join(path, "host_opt")
     if getattr(engine, "_host_opt", None) is not None:

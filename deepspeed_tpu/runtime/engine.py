@@ -157,16 +157,19 @@ class DeepSpeedEngine:
         self.timers = SynchronizedWallClockTimer()
         self._analytic_flops_per_step = None
 
-        # -- ZeRO-Offload tier 1 (host DRAM optimizer) ---------------------
+        # -- ZeRO-Offload tiers (host DRAM optimizer / Infinity streaming) -
         from .zero.offload import validate_offload_config
-        self.offload_enabled = validate_offload_config(self._config)
+        offload_mode = validate_offload_config(self._config)
+        self.offload_enabled = offload_mode == "optimizer"
+        self.infinity_enabled = offload_mode == "infinity"
         self._host_opt = None
         self._host_scaler = None
-        if self.offload_enabled and optimizer is not None:
+        self._infinity = None
+        if offload_mode != "none" and optimizer is not None:
             raise ValueError(
-                "offload_optimizer needs a config-named optimizer "
-                "(Adam/AdamW/Adagrad) — the host step runs in native code, "
-                "not through a user optimizer object")
+                "offload needs a config-named optimizer (Adam/AdamW/"
+                "Adagrad) — the host step runs in native code, not "
+                "through a user optimizer object")
 
         # -- state init (sharded at materialization) -----------------------
         if not dont_init:
@@ -214,6 +217,8 @@ class DeepSpeedEngine:
     # state
     # ------------------------------------------------------------------
     def state_specs(self) -> Dict:
+        if self.infinity_enabled:
+            return {"step": P(), "skipped": P()}
         if self.offload_enabled:
             # device state is ONLY compute-dtype params — masters/moments
             # live on the host (runtime/zero/offload.py)
@@ -234,6 +239,13 @@ class DeepSpeedEngine:
         jitted init materializes only each device's shard (replaces the
         reference's init-then-broadcast `engine.py:1083` and zero.Init
         partition-at-construction `partition_parameters.py:539`)."""
+        if self.infinity_enabled:
+            # ZeRO-Infinity: params/optimizer live in host stores owned by
+            # the stepper; engine state carries only the counters
+            from .zero.infinity import InfinityStepper
+            self._infinity = InfinityStepper(self, rng)
+            return {"step": jnp.zeros((), jnp.int32),
+                    "skipped": jnp.zeros((), jnp.int32)}
         if self.offload_enabled:
             return self._init_state_offload(rng)
 
@@ -595,6 +607,16 @@ class DeepSpeedEngine:
     def train_step(self, batch: Dict) -> Dict:
         """One full optimizer step (gas microbatches). Returns metrics dict
         of device scalars."""
+        if self.infinity_enabled:
+            self.tput_timer.start()
+            metrics = self._infinity.train_step(batch)
+            self.tput_timer.stop()  # streamed step is synchronous
+            self.global_steps += 1
+            self.micro_steps += self.gradient_accumulation_steps
+            if self._config.wall_clock_breakdown:
+                self._step_times.append(metrics["step_time"])
+            self._post_step_observe(metrics, batch)
+            return metrics
         if self.offload_enabled:
             if any(not isinstance(v, jax.Array) for v in
                    jax.tree_util.tree_leaves(batch)):
@@ -689,7 +711,7 @@ class DeepSpeedEngine:
         second copy of the train step mid-loop; the explicit FlopsProfiler
         API is where users pay that cost knowingly."""
         del batch
-        if self.offload_enabled:
+        if self.offload_enabled or self.infinity_enabled:
             return None  # offload step is host-bound; MFU is not the metric
         if self.tput_timer.timed_steps == 0:
             return None
@@ -735,6 +757,8 @@ class DeepSpeedEngine:
         return self.train_step(batch)
 
     def eval_loss(self, batch: Dict) -> jnp.ndarray:
+        if self.infinity_enabled:
+            return jnp.asarray(self._infinity.eval_loss(batch))
         if any(not isinstance(v, jax.Array)
                for v in jax.tree_util.tree_leaves(batch)):
             batch = self.shard_batch(batch)
@@ -751,10 +775,10 @@ class DeepSpeedEngine:
     # 1910, 2121). Each call is an independent jitted program.
     # ------------------------------------------------------------------
     def forward(self, batch: Dict) -> jnp.ndarray:
-        if self.offload_enabled:
+        if self.offload_enabled or self.infinity_enabled:
             raise NotImplementedError(
                 "the compat forward/backward/step surface is not wired for "
-                "optimizer offload — use train_step()/train_batch()")
+                "offload — use train_step()/train_batch()")
         self._last_batch = batch if isinstance(
             next(iter(jax.tree_util.tree_leaves(batch))), jax.Array) \
             else jax.device_put(batch, to_named(

@@ -1,0 +1,11 @@
+"""Swap-tensor tier: slot stores + pipelined host optimizer sweeps.
+
+Reference: `/root/reference/deepspeed/runtime/swap_tensor/` (utils,
+partitioned_param_swapper, partitioned/pipelined_optimizer_swapper).
+"""
+from .partitioned_optimizer_swapper import SlotOptimizer
+from .slot_store import (DramSlotStore, NvmeSlotStore, SlotStore,
+                         make_slot_store)
+
+__all__ = ["SlotOptimizer", "DramSlotStore", "NvmeSlotStore", "SlotStore",
+           "make_slot_store"]

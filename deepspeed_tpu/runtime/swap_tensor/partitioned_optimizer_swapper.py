@@ -1,0 +1,164 @@
+"""Slot-partitioned host optimizer — the optimizer half of ZeRO-Infinity.
+
+Role-equivalent of the reference optimizer swappers
+(`/root/reference/deepspeed/runtime/swap_tensor/partitioned_optimizer_swapper.py`
+and `pipelined_optimizer_swapper.py:55` double-buffered overlap): fp32
+master + Adam moments for each scan layer live in one *slot* of a
+``SlotStore`` (DRAM or NVMe), and the native ``ds_adam_step`` sweep runs
+slot-at-a-time while neighbouring slots stream in/out through the store's
+pinned-buffer ring. The bf16 device copy is emitted by the same sweep
+directly into the parameter store's slot (the reference's fp16 param
+copy-back fused into the update, `csrc/includes/cpu_adam.h` Step_AVX).
+
+Slot layout: ``[master | m | v]`` as three contiguous fp32 spans of
+``n_elems`` each, 4096-aligned total, so one aio read/write moves the whole
+optimizer state of a layer.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional
+
+import numpy as np
+
+from ...ops.adam.cpu_adam import _lib as adam_lib, _C_F32, _C_U16, _ptr
+from ...ops.op_builder import BuildError
+from ...utils.logging import logger
+from .slot_store import SlotStore, make_slot_store
+
+
+class SlotOptimizer:
+    """Adam/AdamW over uniform slots of ``n_elems`` parameters each."""
+
+    STATE_SPANS = 3   # master, m, v
+
+    def __init__(self, n_slots: int, n_elems: int, device: str = "cpu",
+                 nvme_path: Optional[str] = None, aio=None,
+                 buffer_count: int = 4, lr: float = 1e-3,
+                 betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True,
+                 name: str = "opt"):
+        self.n_slots, self.n_elems = int(n_slots), int(n_elems)
+        self.lr, self.betas, self.eps = lr, tuple(betas), eps
+        self.weight_decay, self.adamw_mode = weight_decay, adamw_mode
+        self.step_count = 0
+        slot_nbytes = self.STATE_SPANS * self.n_elems * 4
+        self.store: SlotStore = make_slot_store(
+            device, n_slots, slot_nbytes, nvme_path=nvme_path, aio=aio,
+            buffer_count=buffer_count, name=name)
+        try:
+            self._lib = adam_lib()
+        except BuildError as e:
+            logger.warning(f"native cpu_adam unavailable ({e}); SlotOptimizer "
+                           f"falls back to numpy")
+            self._lib = None
+
+    # -- views -------------------------------------------------------------
+    def _spans(self, buf: np.ndarray):
+        f = buf[:self.STATE_SPANS * self.n_elems * 4].view(np.float32)
+        n = self.n_elems
+        return f[:n], f[n:2 * n], f[2 * n:3 * n]
+
+    # -- lifecycle ---------------------------------------------------------
+    def init_slot(self, slot: int, master_f32: np.ndarray) -> None:
+        buf = self.store.acquire(slot)
+        p, m, v = self._spans(buf)
+        p[:] = master_f32.reshape(-1)
+        m[:] = 0.0
+        v[:] = 0.0
+        self.store.release(slot, dirty=True)
+
+    def master(self, slot: int) -> np.ndarray:
+        """Copy of the slot's fp32 master vector (checkpoint/introspection)."""
+        buf = self.store.acquire(slot)
+        p, _, _ = self._spans(buf)
+        out = p.copy()
+        self.store.release(slot, dirty=False)
+        return out
+
+    def state(self, slot: int):
+        buf = self.store.acquire(slot)
+        p, m, v = self._spans(buf)
+        out = (p.copy(), m.copy(), v.copy())
+        self.store.release(slot, dirty=False)
+        return out
+
+    def load_state(self, slot: int, p: np.ndarray, m: np.ndarray,
+                   v: np.ndarray) -> None:
+        buf = self.store.acquire(slot)
+        sp, sm, sv = self._spans(buf)
+        sp[:] = p.reshape(-1)
+        sm[:] = m.reshape(-1)
+        sv[:] = v.reshape(-1)
+        self.store.release(slot, dirty=True)
+
+    # -- the sweep ---------------------------------------------------------
+    def prefetch(self, slot: int) -> None:
+        self.store.prefetch(slot)
+
+    def step_slot(self, slot: int, grad: np.ndarray, lr: float,
+                  grad_scale: float = 1.0,
+                  out_bf16: Optional[np.ndarray] = None) -> None:
+        """One layer's Adam update. ``step_count`` must have been advanced
+        by ``begin_step()`` for this optimizer step. ``grad`` — fp32 vector,
+        or a uint16 vector of bf16 bits (the wire format of the Infinity
+        grad stream — converted inline by the native sweep). ``out_bf16`` —
+        uint16 view (the param store's slot) receiving the updated bf16
+        params."""
+        buf = self.store.acquire(slot)
+        p, m, v = self._spans(buf)
+        g = grad.reshape(-1)
+        b1, b2 = self.betas
+        if self._lib is not None and g.dtype == np.uint16:
+            self._lib.ds_adam_step_g16(
+                p.size, _ptr(p, _C_F32), _ptr(m, _C_F32), _ptr(v, _C_F32),
+                _ptr(np.ascontiguousarray(g), _C_U16), lr, b1, b2, self.eps,
+                self.weight_decay, self.step_count, grad_scale,
+                int(self.adamw_mode),
+                _ptr(out_bf16, _C_U16) if out_bf16 is not None else _C_U16())
+        elif self._lib is not None:
+            g = np.ascontiguousarray(g, dtype=np.float32)
+            self._lib.ds_adam_step(
+                p.size, _ptr(p, _C_F32), _ptr(m, _C_F32), _ptr(v, _C_F32),
+                _ptr(g, _C_F32), lr, b1, b2, self.eps, self.weight_decay,
+                self.step_count, grad_scale, int(self.adamw_mode),
+                _ptr(out_bf16, _C_U16) if out_bf16 is not None else _C_U16())
+        else:
+            if g.dtype == np.uint16:
+                import ml_dtypes
+                g = g.view(ml_dtypes.bfloat16).astype(np.float32)
+            gf = g.astype(np.float32) / grad_scale
+            if not self.adamw_mode and self.weight_decay:
+                gf = gf + self.weight_decay * p
+            m *= b1
+            m += (1 - b1) * gf
+            v *= b2
+            v += (1 - b2) * gf * gf
+            c1 = 1 - b1 ** self.step_count
+            c2 = 1 - b2 ** self.step_count
+            u = (m / c1) / (np.sqrt(v / c2) + self.eps)
+            if self.adamw_mode and self.weight_decay:
+                u = u + self.weight_decay * p
+            p -= lr * u
+            if out_bf16 is not None:
+                import ml_dtypes
+                out_bf16[:] = p.astype(ml_dtypes.bfloat16).view(np.uint16)
+        self.store.release(slot, dirty=True)
+
+    def begin_step(self) -> int:
+        self.step_count += 1
+        return self.step_count
+
+    def flush(self) -> None:
+        self.store.flush()
+
+    def close(self) -> None:
+        self.store.close()
+
+    @property
+    def host_bytes(self) -> int:
+        return self.store.host_bytes
+
+    @property
+    def disk_bytes(self) -> int:
+        return self.store.disk_bytes

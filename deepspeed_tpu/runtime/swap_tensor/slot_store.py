@@ -1,0 +1,228 @@
+"""Fixed-stride slot stores — the storage layer of the offload tiers.
+
+Role-equivalent of the reference swap-tensor utilities
+(`/root/reference/deepspeed/runtime/swap_tensor/utils.py` SwapBuffer/
+SwapBufferPool/SwapBufferManager and the file-offset bookkeeping inside
+`partitioned_param_swapper.py:35`). Redesigned around the unit this
+framework actually swaps: a *slot* — one scan-layer's flattened parameter
+or optimizer-state vector, every slot the same size. That collapses the
+reference's per-tensor offset maps into ``offset = slot * stride`` and
+makes every transfer one large aligned IO.
+
+Two backends with one API:
+  - ``DramSlotStore`` — a single host allocation; acquire() is a view.
+  - ``NvmeSlotStore`` — one file on the NVMe path; a ring of pinned
+    4096-aligned buffers hides read/write latency behind compute
+    (reference ``pipeline_read``/``pipeline_write`` double buffering,
+    `pipelined_optimizer_swapper.py:55`).
+
+Access contract (matches the streaming train loop's sequential walks):
+``prefetch(slot)`` → ``acquire(slot)`` → mutate → ``release(slot,
+dirty=)``. A buffer is recycled only after its writeback completes, so a
+ring of K buffers tolerates a reuse distance of K-1.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...ops.aio import (ALIGN, AsyncIOHandle, PinnedBuffer, round_up)
+from ...utils.logging import logger
+
+
+class SlotStore:
+    """Abstract fixed-stride slot store."""
+
+    def __init__(self, n_slots: int, slot_nbytes: int):
+        self.n_slots = int(n_slots)
+        self.slot_nbytes = int(slot_nbytes)
+
+    def prefetch(self, slot: int) -> None:
+        raise NotImplementedError
+
+    def acquire(self, slot: int) -> np.ndarray:
+        """uint8[slot_nbytes] view of the slot's bytes, host-resident."""
+        raise NotImplementedError
+
+    def release(self, slot: int, dirty: bool = False) -> None:
+        raise NotImplementedError
+
+    def write_slot(self, slot: int, data: np.ndarray) -> None:
+        """Synchronous populate (init / checkpoint-load path)."""
+        buf = self.acquire(slot)
+        view = data.reshape(-1).view(np.uint8)
+        buf[:view.nbytes] = view
+        self.release(slot, dirty=True)
+
+    def read_slot(self, slot: int, nbytes: Optional[int] = None) -> np.ndarray:
+        """Synchronous copy-out (checkpoint-save path)."""
+        buf = self.acquire(slot)
+        out = buf[:nbytes if nbytes else self.slot_nbytes].copy()
+        self.release(slot, dirty=False)
+        return out
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    @property
+    def host_bytes(self) -> int:
+        return 0
+
+    @property
+    def disk_bytes(self) -> int:
+        return 0
+
+
+class DramSlotStore(SlotStore):
+    """All slots in one host allocation (the DRAM/'cpu' offload tier)."""
+
+    def __init__(self, n_slots: int, slot_nbytes: int):
+        super().__init__(n_slots, slot_nbytes)
+        self._data = np.zeros((n_slots, slot_nbytes), np.uint8)
+
+    def prefetch(self, slot: int) -> None:
+        pass
+
+    def acquire(self, slot: int) -> np.ndarray:
+        return self._data[slot]
+
+    def release(self, slot: int, dirty: bool = False) -> None:
+        pass
+
+    @property
+    def host_bytes(self) -> int:
+        return self._data.nbytes
+
+
+class NvmeSlotStore(SlotStore):
+    """Slots in a single file on the NVMe path, accessed through a pinned
+    buffer ring over the native aio handle (reference
+    `partitioned_param_swapper.py` swap_in/swap_out + inflight tracking)."""
+
+    def __init__(self, n_slots: int, slot_nbytes: int, path: str,
+                 aio: Optional[AsyncIOHandle] = None, buffer_count: int = 4,
+                 name: str = "slots"):
+        super().__init__(n_slots, slot_nbytes)
+        self.stride = round_up(slot_nbytes)
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                    exist_ok=True)
+        self.path = path
+        self.aio = aio or AsyncIOHandle()
+        self._own_aio = aio is None
+        buffer_count = max(2, int(buffer_count))
+        self._bufs = [PinnedBuffer(self.stride) for _ in range(buffer_count)]
+        self._buf_op: List[Optional[int]] = [None] * buffer_count  # inflight
+        self._buf_slot: List[Optional[int]] = [None] * buffer_count
+        self._buf_pins: List[int] = [0] * buffer_count  # acquired, unreleased
+        self._slot_buf: Dict[int, int] = {}   # slot currently materialized
+        self._clock = 0
+        # the stream-mode train loop touches the store from the main thread
+        # (param uploads) and the optimizer worker concurrently
+        self._lock = threading.RLock()
+        # preallocate the file so O_DIRECT offsets always exist
+        total = self.stride * n_slots
+        with open(path, "ab") as f:
+            if f.tell() < total:
+                f.truncate(total)
+        logger.info(f"NvmeSlotStore[{name}]: {n_slots} x "
+                    f"{slot_nbytes / 2**20:.1f} MiB at {path} "
+                    f"({total / 2**30:.2f} GiB file, "
+                    f"{buffer_count} pinned buffers)")
+
+    # -- buffer ring ------------------------------------------------------
+    def _wait_buf(self, b: int) -> None:
+        if self._buf_op[b] is not None:
+            self.aio.wait_op(self._buf_op[b])
+            self._buf_op[b] = None
+
+    def _free_buf(self) -> int:
+        """Next unpinned ring buffer, evicting its previous slot (after any
+        pending IO on it has completed)."""
+        for _ in range(len(self._bufs)):
+            b = self._clock % len(self._bufs)
+            self._clock += 1
+            if self._buf_pins[b] > 0:
+                continue
+            self._wait_buf(b)
+            old = self._buf_slot[b]
+            if old is not None and self._slot_buf.get(old) == b:
+                del self._slot_buf[old]
+            self._buf_slot[b] = None
+            return b
+        raise RuntimeError(
+            f"all {len(self._bufs)} pinned buffers are acquired — raise "
+            f"buffer_count (acquire/release imbalance otherwise)")
+
+    # -- API --------------------------------------------------------------
+    def prefetch(self, slot: int) -> None:
+        with self._lock:
+            if slot in self._slot_buf:
+                return
+            b = self._free_buf()
+            self._buf_op[b] = self.aio.pread(
+                self._bufs[b].array, self.path, slot * self.stride)
+            self._buf_slot[b] = slot
+            self._slot_buf[slot] = b
+
+    def acquire(self, slot: int) -> np.ndarray:
+        with self._lock:
+            if slot not in self._slot_buf:
+                self.prefetch(slot)
+            b = self._slot_buf[slot]
+            self._buf_pins[b] += 1
+            self._wait_buf(b)  # finish the read (or a previous writeback)
+            return self._bufs[b].array[:self.slot_nbytes]
+
+    def release(self, slot: int, dirty: bool = False) -> None:
+        with self._lock:
+            b = self._slot_buf.get(slot)
+            if b is None:
+                return
+            if self._buf_pins[b] > 0:
+                self._buf_pins[b] -= 1
+            if dirty:
+                self._buf_op[b] = self.aio.pwrite(
+                    self._bufs[b].array, self.path, slot * self.stride)
+            # buffer stays mapped (clean cache) until the ring reclaims it
+
+    def flush(self) -> None:
+        self.aio.wait()
+        with self._lock:
+            self._buf_op = [None] * len(self._bufs)
+
+    def close(self) -> None:
+        self.flush()
+        if self._own_aio:
+            self.aio.close()
+        for b in self._bufs:
+            b.free()
+        self._bufs = []
+
+    @property
+    def host_bytes(self) -> int:
+        return sum(b.nbytes for b in self._bufs)
+
+    @property
+    def disk_bytes(self) -> int:
+        return self.stride * self.n_slots
+
+
+def make_slot_store(device: str, n_slots: int, slot_nbytes: int,
+                    nvme_path: Optional[str] = None,
+                    aio: Optional[AsyncIOHandle] = None,
+                    buffer_count: int = 4, name: str = "slots") -> SlotStore:
+    """Factory keyed on the offload device enum ('cpu' → DRAM tier,
+    'nvme' → file tier)."""
+    if device == "nvme":
+        if not nvme_path:
+            raise ValueError("offload device=nvme requires nvme_path")
+        return NvmeSlotStore(n_slots, slot_nbytes,
+                             os.path.join(nvme_path, f"{name}.swp"),
+                             aio=aio, buffer_count=buffer_count, name=name)
+    return DramSlotStore(n_slots, slot_nbytes)

@@ -131,29 +131,36 @@ class ZeroOffloadHostOptimizer:
         self.opt.load_state_arrays(sd["arrays"], int(sd["step_count"]))
 
 
-def validate_offload_config(cfg) -> bool:
-    """Returns True when cpu optimizer offload is active; raises on config
-    the framework cannot honor yet (silent no-ops are bugs — VERDICT)."""
+def validate_offload_config(cfg) -> str:
+    """Classify the offload config → ``"none" | "optimizer" | "infinity"``;
+    raises on configs the framework cannot honor (silent no-ops are bugs).
+
+    ``optimizer`` — host-DRAM optimizer state, params stay in HBM (this
+    module). ``infinity`` — parameter streaming + host/NVMe optimizer
+    state (`runtime/zero/infinity.py`)."""
     z = cfg.zero_config
     oo, op = z.offload_optimizer, z.offload_param
     from ...runtime.config import OffloadDeviceEnum as E
     if op is not None and op.device != E.none:
-        raise NotImplementedError(
-            "offload_param (parameter offload to host/NVMe) is not "
-            "implemented yet — remove the block; optimizer offload "
-            "(offload_optimizer: {device: cpu}) is available")
+        # param offload → the ZeRO-Infinity streamed path; its own
+        # validator enforces the rest (bf16, dense, adam, 1-chip)
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "ZeRO-Infinity is single-host; multi-host param offload "
+                "is not built")
+        return "infinity"
     if oo is None or oo.device == E.none:
-        return False
+        return "none"
     if oo.device == E.nvme:
         raise NotImplementedError(
-            "offload_optimizer device=nvme needs the aio tier (not built "
-            "yet); device=cpu is available")
+            "offload_optimizer device=nvme without offload_param is not a "
+            "built configuration — the NVMe optimizer tier rides the "
+            "ZeRO-Infinity path (add offload_param: {device: cpu}) or use "
+            "device=cpu")
     if jax.process_count() > 1:
         raise NotImplementedError(
             "optimizer offload is single-controller-per-host only for now: "
             "on a multi-host mesh every process would gather full masters "
             "(device_get of non-addressable shards fails) — disable offload "
             "or run single-host")
-    if cfg.aio is not None and getattr(cfg.aio, "_explicit", False):
-        pass  # aio block is harmless config until nvme lands
-    return True
+    return "optimizer"
