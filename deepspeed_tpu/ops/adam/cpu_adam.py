@@ -77,34 +77,43 @@ class DeepSpeedCPUAdam:
         bf16 copies of the updated params."""
         lr = self.lr if lr is None else float(lr)
         self.step_count += 1
-        b1, b2 = self.betas
         for i, g in enumerate(grads):
-            p, m, v = self.master[i], self.m[i], self.v[i]
-            ob = out_bf16[i] if out_bf16 is not None else None
-            if self._lib is not None:
-                g = np.ascontiguousarray(g, dtype=np.float32)
-                self._lib.ds_adam_step(
-                    p.size, _ptr(p, _C_F32), _ptr(m, _C_F32),
-                    _ptr(v, _C_F32), _ptr(g, _C_F32),
-                    lr, b1, b2, self.eps, self.weight_decay,
-                    self.step_count, grad_scale, int(self.adamw_mode),
-                    _ptr(ob, _C_U16) if ob is not None else _C_U16())
-            else:
-                gf = g.astype(np.float32) / grad_scale
-                if not self.adamw_mode and self.weight_decay:
-                    gf = gf + self.weight_decay * p
-                m *= b1
-                m += (1 - b1) * gf
-                v *= b2
-                v += (1 - b2) * gf * gf
-                c1 = 1 - b1 ** self.step_count
-                c2 = 1 - b2 ** self.step_count
-                u = (m / c1) / (np.sqrt(v / c2) + self.eps)
-                if self.adamw_mode and self.weight_decay:
-                    u = u + self.weight_decay * p
-                p -= lr * u
-                if ob is not None:
-                    ob[:] = f32_to_bf16_numpy(p)
+            self.step_one(i, g, lr=lr, grad_scale=grad_scale,
+                          out_bf16=out_bf16[i] if out_bf16 is not None
+                          else None)
+
+    def step_one(self, i: int, g: np.ndarray, lr: float,
+                 grad_scale: float = 1.0,
+                 out_bf16: Optional[np.ndarray] = None) -> None:
+        """Update leaf ``i`` only — the bucketed/pipelined sweeps advance
+        ``step_count`` once themselves, then call this per leaf."""
+        b1, b2 = self.betas
+        p, m, v = self.master[i], self.m[i], self.v[i]
+        ob = out_bf16
+        if self._lib is not None:
+            g = np.ascontiguousarray(g, dtype=np.float32)
+            self._lib.ds_adam_step(
+                p.size, _ptr(p, _C_F32), _ptr(m, _C_F32),
+                _ptr(v, _C_F32), _ptr(g, _C_F32),
+                lr, b1, b2, self.eps, self.weight_decay,
+                self.step_count, grad_scale, int(self.adamw_mode),
+                _ptr(ob, _C_U16) if ob is not None else _C_U16())
+        else:
+            gf = g.astype(np.float32) / grad_scale
+            if not self.adamw_mode and self.weight_decay:
+                gf = gf + self.weight_decay * p
+            m *= b1
+            m += (1 - b1) * gf
+            v *= b2
+            v += (1 - b2) * gf * gf
+            c1 = 1 - b1 ** self.step_count
+            c2 = 1 - b2 ** self.step_count
+            u = (m / c1) / (np.sqrt(v / c2) + self.eps)
+            if self.adamw_mode and self.weight_decay:
+                u = u + self.weight_decay * p
+            p -= lr * u
+            if ob is not None:
+                ob[:] = f32_to_bf16_numpy(p)
 
     def state_arrays(self) -> Dict[str, List[np.ndarray]]:
         return {"master": self.master, "m": self.m, "v": self.v}
@@ -139,23 +148,29 @@ class DeepSpeedCPUAdagrad:
         lr = self.lr if lr is None else float(lr)
         self.step_count += 1
         for i, g in enumerate(grads):
-            p, sq = self.master[i], self.sq[i]
-            ob = out_bf16[i] if out_bf16 is not None else None
-            if self._lib is not None:
-                g = np.ascontiguousarray(g, dtype=np.float32)
-                self._lib.ds_adagrad_step(
-                    p.size, _ptr(p, _C_F32), _ptr(sq, _C_F32),
-                    _ptr(g, _C_F32), lr, self.eps, self.weight_decay,
-                    grad_scale,
-                    _ptr(ob, _C_U16) if ob is not None else _C_U16())
-            else:
-                gf = g.astype(np.float32) / grad_scale
-                if self.weight_decay:
-                    gf = gf + self.weight_decay * p
-                sq += gf * gf
-                p -= lr * gf / (np.sqrt(sq) + self.eps)
-                if ob is not None:
-                    ob[:] = f32_to_bf16_numpy(p)
+            self.step_one(i, g, lr=lr, grad_scale=grad_scale,
+                          out_bf16=out_bf16[i] if out_bf16 is not None
+                          else None)
+
+    def step_one(self, i: int, g, lr: float, grad_scale: float = 1.0,
+                 out_bf16=None) -> None:
+        p, sq = self.master[i], self.sq[i]
+        ob = out_bf16
+        if self._lib is not None:
+            g = np.ascontiguousarray(g, dtype=np.float32)
+            self._lib.ds_adagrad_step(
+                p.size, _ptr(p, _C_F32), _ptr(sq, _C_F32),
+                _ptr(g, _C_F32), lr, self.eps, self.weight_decay,
+                grad_scale,
+                _ptr(ob, _C_U16) if ob is not None else _C_U16())
+        else:
+            gf = g.astype(np.float32) / grad_scale
+            if self.weight_decay:
+                gf = gf + self.weight_decay * p
+            sq += gf * gf
+            p -= lr * gf / (np.sqrt(sq) + self.eps)
+            if ob is not None:
+                ob[:] = f32_to_bf16_numpy(p)
 
     def state_arrays(self):
         return {"master": self.master, "sq": self.sq}

@@ -122,6 +122,13 @@ class ZeroConfig(ConfigModel):
     # TPU-native: how many layer blocks to scan over for stage-3 gather
     # scheduling (0 = let XLA decide; >0 = lax.scan over stacked blocks).
     stage3_scan_layers: int = 0
+    # ZeRO-Infinity: initialize layer slots host-side (numpy RNG) instead of
+    # materializing each layer on device and fetching it. The values differ
+    # from model.init's (different RNG), so use only for from-scratch runs
+    # where init distribution, not init bits, matters — it removes a
+    # 4-bytes/param device→host fetch at startup, which dominates init time
+    # on hosts with slow D2H links.
+    infinity_host_init: bool = False
 
     @model_validator(mode="after")
     def _resolve_deprecated(self):

@@ -354,15 +354,21 @@ class DeepSpeedEngine:
                     and np.isfinite(gnorm):
                 factor = min(1.0, cfg.gradient_clipping / max(gnorm, 1e-6))
             lr = float(self.lr_schedule(jnp.asarray(step_i)))
-            grad_leaves = [np.asarray(x) for x in
-                           jax.tree_util.tree_leaves(jax.device_get(grads))]
-            uploads = self._host_opt.step(
-                grad_leaves, lr=lr, grad_scale=denom / factor,
-                emit_bf16=(self.compute_dtype == jnp.bfloat16))
-            if self.compute_dtype == jnp.float16:
-                uploads = [u.astype(np.float16) for u in uploads]
-            new_leaves = [jax.device_put(u, s)
-                          for u, s in zip(uploads, self._offload_shardings)]
+            # overlapped sweep: bucket i+1 D2H || bucket i native Adam ||
+            # bucket i-1 H2D (reference PipelinedOptimizerSwapper:55)
+            grad_dev = jax.tree_util.tree_leaves(grads)
+            for g in grad_dev:
+                try:
+                    g.copy_to_host_async()
+                except Exception:
+                    pass
+            new_leaves = self._host_opt.step_pipelined(
+                grad_dev, self._offload_shardings, lr=lr,
+                grad_scale=denom / factor,
+                emit_bf16=(self.compute_dtype == jnp.bfloat16),
+                upload_dtype=(np.float16
+                              if self.compute_dtype == jnp.float16
+                              else None))
             self.state["params"] = jax.tree_util.tree_unflatten(
                 self._host_opt.treedef, new_leaves)
             self.state["step"] = self.state["step"] + 1

@@ -129,15 +129,18 @@ class InfinityStepper:
         # -- init ----------------------------------------------------------
         self._init_state(rng)
 
-        # resident host optimizer (small tree: embeddings + norms + head)
-        from ...ops.adam.cpu_adam import DeepSpeedCPUAdam
-        res_host = jax.device_get(self.resident)
-        self._res_leaves, self._res_treedef = jax.tree_util.tree_flatten(
-            res_host)
-        self.res_opt = DeepSpeedCPUAdam(
-            [np.asarray(l, np.float32) for l in self._res_leaves],
-            lr=self.lr_default, betas=betas, eps=eps, weight_decay=wd,
-            adamw_mode=adamw)
+        # Resident tier (embeddings + norms + head) keeps masters AND Adam
+        # moments on DEVICE: the resident tree is small relative to blocks
+        # but its gradients are model-width x vocab — streaming them
+        # device→host every step would put megabytes-per-step on the slow
+        # D2H wire for no memory win. ~16 bytes/param of HBM buys zero
+        # per-step resident transfers. The update is the engine's own
+        # configured Optimizer (runtime/optimizers.py adam — one source of
+        # the Adam math alongside the native host sweep).
+        self._res_treedef = jax.tree_util.tree_structure(self.resident)
+        self._res_optim = engine.optimizer
+        with self.engine.mesh:
+            self.res_state = jax.jit(self._res_optim.init)(self.resident)
 
         # -- compiled programs (built lazily per batch-key signature) ------
         self._programs: Dict = {}
@@ -199,29 +202,89 @@ class InfinityStepper:
     def _init_state(self, rng) -> None:
         """Materialize one layer at a time on device, spill to the stores.
         Layer i here is bit-identical to row i of ``model.init`` (the vmap
-        over ``superblock_keys`` — parity tested)."""
+        over ``superblock_keys`` — parity tested).
+
+        With ``infinity_host_init`` the layer slots are drawn host-side
+        instead (same shapes/scales, different RNG) — skips the per-layer
+        device→host fetch, which dominates startup on slow D2H links."""
         model = self.model
         with self.engine.mesh:
             self.resident = jax.jit(model.init_resident)(rng)
-
-            def one_layer(k):
-                leaves = jax.tree_util.tree_leaves(model.init_superblock(k))
-                flat = jnp.concatenate(
-                    [l.reshape(-1).astype(jnp.float32) for l in leaves])
-                return flat, flat.astype(jnp.bfloat16)
-
-            init_fn = jax.jit(one_layer)
-            keys = model.superblock_keys(rng)
+        if self.engine._config.zero_config.infinity_host_init:
+            nrng = np.random.default_rng(
+                int(jax.random.randint(rng, (), 0, 2**31 - 1)))
+            flat = np.empty(self.n_elems, np.float32)
+            stds = self._host_init_stds()
             for i in range(self.L):
-                f32, b16 = init_fn(keys[i])
-                f32_h = np.asarray(f32)
-                self.opt.init_slot(i, f32_h)
+                for off, size, std in zip(self._offsets, self._sizes, stds):
+                    span = flat[off:off + size]
+                    if std > 0.0:
+                        span[:] = nrng.standard_normal(
+                            size, dtype=np.float32) * std
+                    else:          # biases 0 (norm scales fixed up below)
+                        span[:] = 0.0
+                self._set_norm_scales_one(self._unflatten_host(flat))
+                self.opt.init_slot(i, flat)
                 buf = self.param_store.acquire(i)
-                buf[:self.n_elems * 2].view(np.uint16)[:] = np.asarray(
-                    b16).view(np.uint16)
+                buf[:self.n_elems * 2].view(np.uint16)[:] = (
+                    flat.astype(ml_dtypes.bfloat16).view(np.uint16))
                 self.param_store.release(i, dirty=True)
+        else:
+            with self.engine.mesh:
+                def one_layer(k):
+                    leaves = jax.tree_util.tree_leaves(
+                        model.init_superblock(k))
+                    flat = jnp.concatenate(
+                        [l.reshape(-1).astype(jnp.float32) for l in leaves])
+                    return flat, flat.astype(jnp.bfloat16)
+
+                init_fn = jax.jit(one_layer)
+                keys = model.superblock_keys(rng)
+                for i in range(self.L):
+                    f32, b16 = init_fn(keys[i])
+                    f32_h = np.asarray(f32)
+                    self.opt.init_slot(i, f32_h)
+                    buf = self.param_store.acquire(i)
+                    buf[:self.n_elems * 2].view(np.uint16)[:] = np.asarray(
+                        b16).view(np.uint16)
+                    self.param_store.release(i, dirty=True)
         self.param_store.flush()
         self.opt.flush()
+
+    def _host_init_stds(self) -> List[float]:
+        """Per-leaf init stddev matching model init (models/transformer.py
+        _block_init): 0.02 for kernels, 0.02/sqrt(2*num_layers) for the
+        residual-branch projections (scaled_init), 0 for 1-d leaves."""
+        layer_tpl = jax.eval_shape(self.model.init_superblock,
+                                   jax.random.PRNGKey(0))
+        nl = self.model.config.num_layers
+
+        def std_for(path, leaf):
+            keys = tuple(str(getattr(p, "key", "")) for p in path)
+            if len(leaf.shape) < 2:
+                return 0.0
+            if keys[-2:] in (("out", "kernel"), ("fc_out", "kernel")):
+                return 0.02 / math.sqrt(2.0 * nl)
+            return 0.02
+        tree = jax.tree_util.tree_map_with_path(std_for, layer_tpl)
+        return jax.tree_util.tree_leaves(tree)
+
+    def _unflatten_host(self, flat: np.ndarray):
+        """Host-side views of a flat slot, shaped as the layer tree."""
+        leaves = [flat[o:o + s].reshape(sh)
+                  for o, s, sh in zip(self._offsets, self._sizes,
+                                      self._shapes)]
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    def _set_norm_scales_one(self, layer_tree) -> None:
+        """Host init: norm 'scale' leaves → 1.0 (views mutate the slot)."""
+        def visit(path, leaf):
+            keys = [getattr(p, "key", "") for p in path]
+            if any(str(k).startswith("ln") for k in keys) and \
+                    "scale" in [str(k) for k in keys]:
+                leaf[...] = 1.0
+            return leaf
+        jax.tree_util.tree_map_with_path(visit, layer_tree)
 
     # ------------------------------------------------------------------
     # device layer cache
@@ -485,21 +548,23 @@ class InfinityStepper:
             self.param_store.release(i, dirty=True)
             self._grad_accum[i] = 0.0
 
-    def _sum_resident_grads(self, grad_trees: List) -> List[np.ndarray]:
-        grads = [np.zeros_like(l, dtype=np.float32)
-                 for l in self._res_leaves]
-        for t in grad_trees:
-            for dst, g in zip(grads, jax.tree_util.tree_leaves(
-                    jax.device_get(t))):
-                dst += np.asarray(g, np.float32)
-        return grads
-
-    def _step_resident(self, grads: List[np.ndarray], lr: float,
+    def _step_resident(self, grads_dev, lr: float,
                        grad_scale: float) -> None:
-        self.res_opt.step(grads, lr=lr, grad_scale=grad_scale)
-        new = jax.tree_util.tree_unflatten(
-            self._res_treedef, [np.asarray(m) for m in self.res_opt.master])
-        self.resident = jax.device_put(new)
+        """Device-resident optimizer step over the summed resident grad
+        tree (the engine's configured Optimizer; grad_scale folds
+        microbatch count x clip factor, like the native sweep)."""
+        if getattr(self, "_res_apply", None) is None:
+            opt = self._res_optim
+
+            def apply(res, st, g, lr_, scale):
+                g = jax.tree_util.tree_map(lambda x: x / scale, g)
+                return opt.apply(g, st, res, lr_)
+            with self.engine.mesh:
+                self._res_apply = jax.jit(apply)
+        self.resident, self.res_state = self._res_apply(
+            self.resident, self.res_state, grads_dev,
+            jnp.asarray(lr, jnp.float32),
+            jnp.asarray(grad_scale, jnp.float32))
 
     # ------------------------------------------------------------------
     # public API
@@ -518,8 +583,15 @@ class InfinityStepper:
         futures = []
         loss_total = 0.0
         sq_total = 0.0
-        res_grads = []
+        res_acc = None
         self._dev.clear()
+        if getattr(self, "_res_add", None) is None:
+            with self.engine.mesh:
+                self._res_add = jax.jit(lambda a, b: jax.tree_util.tree_map(
+                    jnp.add, a, b))
+                self._res_sq = jax.jit(lambda t: sum(
+                    jnp.sum(jnp.square(l))
+                    for l in jax.tree_util.tree_leaves(t)))
         for j in range(gas):
             if stream:
                 def on_grad(i, dflat):
@@ -535,20 +607,19 @@ class InfinityStepper:
                 mask[j] if mask is not None else None, on_grad)
             loss_total += float(loss)
             sq_total += float(sq)
-            res_grads.append(d_res)
+            res_acc = d_res if res_acc is None else self._res_add(res_acc,
+                                                                 d_res)
         for f in futures:
             f.result()   # surface worker exceptions, join the sweep
 
         grad_scale = float(gas)
-        res_sum = self._sum_resident_grads(res_grads)
         if stream:
             # gas==1: Σ per-layer ||g||² IS the exact squared norm
             gnorm = math.sqrt(sq_total)
         else:
             # exact norm of the ACCUMULATED grads (clipping must see the
             # true norm — reference runtime/utils.py:325 clip_grad_norm_)
-            sq = sum(float(np.dot(g.reshape(-1), g.reshape(-1)))
-                     for g in res_sum)
+            sq = float(self._res_sq(res_acc))
             if self._grad_accum is not None:
                 for i in range(self.L):
                     row = self._grad_accum[i]
@@ -557,7 +628,7 @@ class InfinityStepper:
             if self.clip > 0.0 and np.isfinite(gnorm) and gnorm > self.clip:
                 grad_scale *= gnorm / self.clip
             self._sweep_collected(lr, grad_scale)
-        self._step_resident(res_sum, lr, grad_scale)
+        self._step_resident(res_acc, lr, grad_scale)
         self._dev.clear()   # device copies are stale after the sweep
         self._sweep_uploads(block=True)
         self.param_store.flush()
@@ -598,8 +669,9 @@ class InfinityStepper:
         for o, s, sh in zip(self._offsets, self._sizes, self._shapes):
             leaves.append(blocks_flat[:, o:o + s].reshape((self.L,) + sh))
         blocks = jax.tree_util.tree_unflatten(self._treedef, leaves)
-        res = jax.tree_util.tree_unflatten(
-            self._res_treedef, [m.copy() for m in self.res_opt.master])
+        res = jax.device_get(self.resident)
+        res = jax.tree_util.tree_map(
+            lambda x: np.asarray(x, np.float32), res)
         res["blocks"] = blocks
         return res
 
@@ -615,15 +687,44 @@ class InfinityStepper:
         for i in range(self.L):
             p, m, v = self.opt.state(i)
             np.savez(os.path.join(path, f"slot_{i:05d}.npz"), p=p, m=m, v=v)
-        res = self.res_opt.state_arrays()
+        res = self._resident_state_host()
         np.savez(os.path.join(path, "resident.npz"),
                  **{f"{k}_{j}": a for k, arrs in res.items()
                     for j, a in enumerate(arrs)})
         with open(os.path.join(path, "meta.json"), "w") as f:
             json.dump({"L": self.L, "n_elems": self.n_elems,
                        "step_count": self.opt.step_count,
-                       "res_step_count": self.res_opt.step_count,
-                       "n_res_leaves": len(self._res_leaves)}, f)
+                       "res_step_count": self.res_step_count,
+                       "n_res_leaves": len(res["master"])}, f)
+
+    @property
+    def res_step_count(self) -> int:
+        return int(self.res_state["step"])
+
+    def _resident_state_host(self) -> Dict[str, List[np.ndarray]]:
+        """Device-resident optimizer state → host leaf lists."""
+        return {
+            "master": [np.asarray(x, np.float32) for x in
+                       jax.tree_util.tree_leaves(
+                           jax.device_get(self.resident))],
+            "m": [np.asarray(x) for x in jax.tree_util.tree_leaves(
+                jax.device_get(self.res_state["m"]))],
+            "v": [np.asarray(x) for x in jax.tree_util.tree_leaves(
+                jax.device_get(self.res_state["v"]))],
+        }
+
+    def _load_resident_state(self, res: Dict[str, List[np.ndarray]],
+                             step_count: int) -> None:
+        # single-chip path (validated at __init__), so plain device_put
+        # places these correctly; a future multi-chip infinity would need
+        # the init-time shardings here
+        def put(leaves):
+            return jax.device_put(jax.tree_util.tree_unflatten(
+                self._res_treedef,
+                [np.asarray(a, np.float32) for a in leaves]))
+        self.resident = put(res["master"])
+        self.res_state = {"step": jnp.asarray(int(step_count), jnp.int32),
+                          "m": put(res["m"]), "v": put(res["v"])}
 
     def load_from_dir(self, path: str, load_optimizer_states: bool = True
                       ) -> None:
@@ -649,19 +750,15 @@ class InfinityStepper:
         with np.load(os.path.join(path, "resident.npz")) as z:
             n = meta["n_res_leaves"]
             res = {k: [z[f"{k}_{j}"] for j in range(n)]
-                   for k in self.res_opt.state_arrays()}
+                   for k in ("master", "m", "v")}
         if not load_optimizer_states:
             res = {k: (arrs if k == "master"
                        else [np.zeros_like(a) for a in arrs])
                    for k, arrs in res.items()}
-        self.res_opt.load_state_arrays(
+        self._load_resident_state(
             res, meta["res_step_count"] if load_optimizer_states else 0)
-        if load_optimizer_states:
-            self.opt.step_count = int(meta["step_count"])
-        else:
-            self.opt.step_count = 0
-        self.resident = jax.device_put(jax.tree_util.tree_unflatten(
-            self._res_treedef, [np.asarray(m) for m in self.res_opt.master]))
+        self.opt.step_count = (int(meta["step_count"])
+                               if load_optimizer_states else 0)
         self.param_store.flush()
         self.opt.flush()
 
@@ -669,8 +766,8 @@ class InfinityStepper:
         return {
             "step_count": self.opt.step_count,
             "slots": [self.opt.state(i) for i in range(self.L)],
-            "resident": self.res_opt.state_arrays(),
-            "res_step_count": self.res_opt.step_count,
+            "resident": self._resident_state_host(),
+            "res_step_count": self.res_step_count,
         }
 
     def load_state_dict(self, sd: Dict) -> None:
@@ -681,10 +778,7 @@ class InfinityStepper:
             buf[:self.n_elems * 2].view(np.uint16)[:] = (
                 p.astype(ml_dtypes.bfloat16).view(np.uint16))
             self.param_store.release(i, dirty=True)
-        self.res_opt.load_state_arrays(sd["resident"],
-                                       int(sd["res_step_count"]))
-        self.resident = jax.device_put(jax.tree_util.tree_unflatten(
-            self._res_treedef, [np.asarray(m) for m in self.res_opt.master]))
+        self._load_resident_state(sd["resident"], sd["res_step_count"])
         self.param_store.flush()
         self.opt.flush()
 

@@ -111,6 +111,74 @@ class ZeroOffloadHostOptimizer:
             return [b.view(ml_dtypes.bfloat16) for b in self._bf16]
         return self.opt.master
 
+    def step_pipelined(self, grad_dev_leaves: List, shardings: List,
+                       lr: float, grad_scale: float, emit_bf16: bool,
+                       upload_dtype=None,
+                       bucket_bytes: int = 32 << 20) -> List:
+        """Overlapped offload step (reference
+        ``PipelinedOptimizerSwapper``, `pipelined_optimizer_swapper.py:55`):
+        leaves are walked in buckets of ~``bucket_bytes`` so that bucket
+        i+1's device→host gradient fetch, bucket i's native optimizer
+        sweep (worker thread — ctypes releases the GIL), and bucket i-1's
+        host→device parameter upload all run concurrently.
+
+        ``grad_dev_leaves`` — device arrays (fetch started with
+        copy_to_host_async by the caller); returns the new device param
+        leaves in order."""
+        from concurrent.futures import ThreadPoolExecutor
+        if emit_bf16 and self._bf16 is None:
+            self._bf16 = [np.empty(m.shape, np.uint16)
+                          for m in self.opt.master]
+        # bucket boundaries over the leaf list
+        buckets: List[List[int]] = [[]]
+        acc = 0
+        for idx, m in enumerate(self.opt.master):
+            buckets[-1].append(idx)
+            acc += m.nbytes
+            if acc >= bucket_bytes:
+                buckets.append([])
+                acc = 0
+        if not buckets[-1]:
+            buckets.pop()
+
+        self.opt.step_count += 1
+
+        def sweep(idxs, ghosts):
+            for k, gi in zip(idxs, ghosts):
+                self.opt.step_one(k, gi, lr=lr, grad_scale=grad_scale,
+                                  out_bf16=(self._bf16[k] if emit_bf16
+                                            else None))
+            if emit_bf16:
+                return [self._bf16[k].view(ml_dtypes.bfloat16)
+                        for k in idxs]
+            return [self.opt.master[k] for k in idxs]
+
+        new_leaves: List = [None] * len(self.opt.master)
+
+        def upload(idxs, outs):
+            for k, o in zip(idxs, outs):
+                if upload_dtype is not None:
+                    o = o.astype(upload_dtype)
+                new_leaves[k] = jax.device_put(o, shardings[k])
+
+        if not hasattr(self, "_pool"):
+            self._pool = ThreadPoolExecutor(max_workers=1,
+                                            thread_name_prefix="offload-opt")
+        prev: Optional[tuple] = None
+        for idxs in buckets:
+            ghosts = [np.asarray(grad_dev_leaves[k]) for k in idxs]  # D2H
+            fut = self._pool.submit(sweep, idxs, ghosts)
+            if prev is not None:
+                # upload bucket i-1 on the main thread WHILE the worker
+                # sweeps bucket i — result() only joins the already-queued
+                # i-1 sweep, keeping all three lanes busy
+                p_idxs, p_fut = prev
+                upload(p_idxs, p_fut.result())
+            prev = (idxs, fut)
+        p_idxs, p_fut = prev
+        upload(p_idxs, p_fut.result())
+        return new_leaves
+
     def reset_from_params(self, params_tree) -> None:
         """Re-derive masters from a (restored) device param tree and zero
         the moments — the module-only / no-optimizer-states load path."""
