@@ -487,13 +487,24 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # 1-bit Adam: shard_map'd step over the compression axis
     # ------------------------------------------------------------------
-    def _build_onebit_train_step(self):
+    def _onebit_program_key(self) -> str:
+        """Phase key for the step ABOUT to run (1-based step index).
+        OnebitAdam/Lamb: warmup|compress at the freeze boundary; 0/1-Adam:
+        var|comp|local|sync from its host schedule."""
+        opt = self.optimizer
+        t = self.global_steps + 1
+        if getattr(opt, "program_key", None) is not None:
+            return opt.program_key(t)
+        return "warmup" if t <= opt.freeze_step else "compress"
+
+    def _build_onebit_train_step(self, key: Optional[str] = None):
         """Compiled step for 1-bit optimizers. Grads stay LOCAL to each
         ``comm_axis`` replica (partial-manual shard_map; other axes remain
         GSPMD-auto); the optimizer owns the cross-replica reduction —
-        full-precision pmean in warmup, error-compensated 1-bit allreduce
-        of the momentum in compression (reference fp16/onebit/adam.py;
-        nothing reduces grads twice). Rebuilt at the freeze boundary."""
+        full-precision pmean in warmup/var phases, error-compensated 1-bit
+        collectives elsewhere (reference fp16/onebit/{adam,zoadam,lamb}.py;
+        nothing reduces grads twice). ONE program per phase key, cached —
+        phase switches are host decisions between steps."""
         from jax.sharding import PartitionSpec as P
         opt = self.optimizer
         axis = opt.comm_axis
@@ -501,14 +512,16 @@ class DeepSpeedEngine:
         w = self.mesh.shape.get(axis, 1)
         if self.fp16_enabled:
             raise NotImplementedError(
-                "1-bit Adam with fp16 loss scaling is not wired; use bf16")
+                "1-bit optimizers with fp16 loss scaling are not wired; "
+                "use bf16")
         if self._config.gradient_clipping:
             logger.warning(
                 "gradient_clipping is ignored by the 1-bit optimizer "
                 "(momentum, not gradients, is communicated — same "
                 "restriction as the reference)")
-        compression = self.global_steps >= opt.freeze_step
-        self._onebit_phase = compression
+        if key is None:
+            key = self._onebit_program_key()
+        self._onebit_key = key
         if getattr(self, "_onebit_errors", None) is None:
             def espec(leaf):
                 return P(axis, *([None] * (leaf.ndim - 1)))
@@ -518,56 +531,75 @@ class DeepSpeedEngine:
             shardings = jax.tree_util.tree_map(
                 lambda l: NamedSharding(self.mesh, espec(l)), errs)
             self._onebit_errors = jax.device_put(errs, shardings)
+        if getattr(self, "_onebit_compiled", None) is None:
+            self._onebit_compiled = {}
 
-        def core(state, errors, batch):
-            gsum, lsum = self._accumulate_micro_grads(
-                state, batch, jnp.asarray(1.0, jnp.float32))
-            grads = jax.tree_util.tree_map(lambda g: g / gas, gsum)
-            lr = self.lr_schedule(state["step"])
-            if compression:
-                new_params, new_opt, new_errors = opt.compression_apply(
-                    grads, state["opt"], state["params"], lr, errors)
-            else:
-                new_params, new_opt = opt.apply(
-                    grads, state["opt"], state["params"], lr)
-                new_errors = errors
-            new_state = {"step": state["step"] + 1,
-                         "skipped": state["skipped"],
-                         "params": new_params, "opt": new_opt}
-            loss = jax.lax.pmean(lsum, axis) / gas
-            # observability must not reintroduce the traffic 1-bit removes:
-            # a full-precision pmean of the grad TREE would cost an exact
-            # allreduce per step. Report the mean of per-replica local norms
-            # instead (one scalar on the wire) — an upper bound on the norm
-            # of the averaged gradient, documented as such.
-            gnorm = jax.lax.pmean(global_norm(grads), axis)
-            return new_state, new_errors, {"loss": loss, "grad_norm": gnorm,
-                                           "lr": lr,
-                                           "overflow": jnp.zeros((),
-                                                                 jnp.int32),
-                                           "loss_scale": jnp.asarray(
-                                               1.0, jnp.float32)}
+        if key not in self._onebit_compiled:
+            programs = getattr(opt, "programs", None) or {
+                "warmup": (opt.apply, False),
+                "compress": (opt.compression_apply, True)}
+            apply_fn, uses_errors = programs[key]
 
-        state_specs = jax.tree_util.tree_map(lambda _: P(),
-                                             self.state_specs())
-        err_in = jax.tree_util.tree_map(
-            lambda l: P(axis), self._onebit_errors)
+            def core(state, errors, batch):
+                gsum, lsum = self._accumulate_micro_grads(
+                    state, batch, jnp.asarray(1.0, jnp.float32))
+                grads = jax.tree_util.tree_map(lambda g: g / gas, gsum)
+                lr = self.lr_schedule(state["step"])
+                if uses_errors:
+                    new_params, new_opt, new_errors = apply_fn(
+                        grads, state["opt"], state["params"], lr, errors)
+                else:
+                    new_params, new_opt = apply_fn(
+                        grads, state["opt"], state["params"], lr)
+                    new_errors = errors
+                new_state = {"step": state["step"] + 1,
+                             "skipped": state["skipped"],
+                             "params": new_params, "opt": new_opt}
+                loss = jax.lax.pmean(lsum, axis) / gas
+                # observability must not reintroduce the traffic 1-bit
+                # removes: report the mean of per-replica local norms (one
+                # scalar on the wire) — an upper bound on the norm of the
+                # averaged gradient, documented as such.
+                gnorm = jax.lax.pmean(global_norm(grads), axis)
+                return new_state, new_errors, {
+                    "loss": loss, "grad_norm": gnorm, "lr": lr,
+                    "overflow": jnp.zeros((), jnp.int32),
+                    "loss_scale": jnp.asarray(1.0, jnp.float32)}
 
-        def step_fn(state, errors, batch):
-            bspec = jax.tree_util.tree_map(lambda _: P(None, axis), batch)
-            sharded = jax.shard_map(
-                core, mesh=self.mesh,
-                in_specs=(state_specs, err_in, bspec),
-                out_specs=(state_specs, err_in,
-                           jax.tree_util.tree_map(lambda _: P(),
-                                                  {"loss": 0, "grad_norm": 0,
-                                                   "lr": 0, "overflow": 0,
-                                                   "loss_scale": 0})),
-                axis_names={axis}, check_vma=False)
-            return sharded(state, errors, batch)
+            state_specs = jax.tree_util.tree_map(lambda _: P(),
+                                                 self.state_specs())
+            err_in = jax.tree_util.tree_map(
+                lambda l: P(axis), self._onebit_errors)
 
-        with self.mesh:
-            compiled = jax.jit(step_fn, donate_argnums=(0, 1))
+            def step_fn(state, errors, batch):
+                bspec = jax.tree_util.tree_map(lambda _: P(None, axis),
+                                               batch)
+                sharded = jax.shard_map(
+                    core, mesh=self.mesh,
+                    in_specs=(state_specs, err_in, bspec),
+                    out_specs=(state_specs, err_in,
+                               jax.tree_util.tree_map(
+                                   lambda _: P(),
+                                   {"loss": 0, "grad_norm": 0, "lr": 0,
+                                    "overflow": 0, "loss_scale": 0})),
+                    axis_names={axis}, check_vma=False)
+                return sharded(state, errors, batch)
+
+            with self.mesh:
+                self._onebit_compiled[key] = jax.jit(step_fn,
+                                                     donate_argnums=(0, 1))
+
+        # error buffers re-zero when a reset-marked phase first activates
+        # (reference reinitial_error_buffer, zoadam.py:324)
+        if key in getattr(opt, "reset_errors_on", ()) and \
+                not getattr(self, "_onebit_errors_reset", False):
+            with self.mesh:
+                self._onebit_errors = jax.jit(
+                    lambda e: jax.tree_util.tree_map(jnp.zeros_like, e),
+                    donate_argnums=(0,))(self._onebit_errors)
+            self._onebit_errors_reset = True
+
+        compiled = self._onebit_compiled[key]
 
         def run(state, batch):
             new_state, self._onebit_errors, metrics = compiled(
@@ -637,11 +669,11 @@ class DeepSpeedEngine:
                 self._step_times.append(time.perf_counter() - t0)
             self._post_step_observe(metrics, batch)
             return metrics
-        if self.optimizer.hyperparams.get("onebit") and \
-                getattr(self, "_onebit_phase", None) is not None and \
-                self._onebit_phase != (
-                    self.global_steps >= self.optimizer.freeze_step):
-            self._train_step_fn = None    # warmup→compression: new program
+        if self.optimizer.hyperparams.get("onebit"):
+            key = self._onebit_program_key()
+            if key != getattr(self, "_onebit_key", None) or \
+                    self._train_step_fn is None:
+                self._build_onebit_train_step(key)
         if self._train_step_fn is None:
             self._build_train_step()
         if any(not isinstance(v, jax.Array) for v in

@@ -31,12 +31,42 @@ from ...optimizers import Optimizer, _tmap, _unzip, _zeros_like_f32
 
 @dataclasses.dataclass(frozen=True)
 class OnebitOptimizer(Optimizer):
-    """Optimizer + the compression-phase apply and error-buffer factory."""
+    """Optimizer + per-phase compiled programs and error-buffer factory.
+
+    ``programs`` maps a phase key → ``(apply_fn, uses_errors)``; the engine
+    compiles one XLA program per key and picks by ``program_key(step)`` —
+    the TPU shape of the reference's runtime flag-flipping (freeze_key /
+    var_interval / local_step_interval): schedule decisions are HOST
+    control flow between steps, never data-dependent branches inside one.
+    ``reset_errors_on`` — keys whose first activation zeroes the error
+    buffers (the reference's reinitial_error_buffer on entering 0/1-Adam's
+    local-step phase, `zoadam.py:324`)."""
     compression_apply: Any = None
     init_errors: Any = None
     freeze_step: int = 100
     comm_axis: str = "dcn_data"
     variant: str = "onebitadam"
+    programs: Any = None          # Dict[str, Tuple[fn, uses_errors]]
+    program_key: Any = None       # Callable[[int], str], step is 1-based
+    reset_errors_on: Any = ()
+
+
+def make_init_errors(comm_axis: str):
+    """Per-replica error-feedback buffer factory (leading axis = world) —
+    shared by all three 1-bit optimizers."""
+    def init_errors(params, world: int):
+        def we(p):
+            return jnp.zeros((world,) + p.shape, jnp.float32)
+
+        def se(p):
+            n = int(p.size)
+            if n % world:
+                raise ValueError(
+                    f"param numel {n} must divide by world {world} for "
+                    f"1-bit chunking (pad or keep {comm_axis}=1)")
+            return jnp.zeros((world, n // world), jnp.float32)
+        return {"worker": _tmap(we, params), "server": _tmap(se, params)}
+    return init_errors
 
 
 def onebit_adam(lr_default: float = 1e-3, betas=(0.9, 0.999),
@@ -51,19 +81,7 @@ def onebit_adam(lr_default: float = 1e-3, betas=(0.9, 0.999),
                 "m": _zeros_like_f32(params),
                 "v": _zeros_like_f32(params)}
 
-    def init_errors(params, world: int):
-        """Per-replica error-feedback buffers (leading axis = world)."""
-        def we(p):
-            return jnp.zeros((world,) + p.shape, jnp.float32)
-
-        def se(p):
-            n = int(p.size)
-            if n % world:
-                raise ValueError(
-                    f"param numel {n} must divide by world {world} for "
-                    f"1-bit chunking (pad or keep {comm_axis}=1)")
-            return jnp.zeros((world, n // world), jnp.float32)
-        return {"worker": _tmap(we, params), "server": _tmap(se, params)}
+    init_errors = make_init_errors(comm_axis)
 
     def _update(m, v_used, p, lr):
         u = m / (jnp.sqrt(v_used) + eps)
@@ -121,19 +139,27 @@ def onebit_adam(lr_default: float = 1e-3, betas=(0.9, 0.999),
                          weight_decay=weight_decay,
                          freeze_step=freeze_step, onebit=True),
         compression_apply=compression_apply, init_errors=init_errors,
-        freeze_step=freeze_step, comm_axis=comm_axis, variant=variant)
+        freeze_step=freeze_step, comm_axis=comm_axis, variant=variant,
+        programs={"warmup": (warmup_apply, False),
+                  "compress": (compression_apply, True)},
+        program_key=lambda t: "warmup" if t <= freeze_step else "compress")
 
 
 def get_onebit_optimizer(name: str, lr=None, betas=(0.9, 0.999), **params):
     """Registry hook for runtime/optimizers.py get_optimizer."""
     name_l = name.lower().replace("_", "")
-    if name_l not in ("onebitadam", "zerooneadam", "onebitlamb"):
-        raise ValueError(f"unknown onebit optimizer {name}")
+    if name_l == "onebitadam":
+        return onebit_adam(
+            lr if lr is not None else 1e-3, tuple(betas),
+            params.pop("eps", 1e-8), params.pop("weight_decay", 0.0),
+            params.pop("freeze_step", 100),
+            params.pop("comm_axis", "dcn_data"))
+    if name_l == "zerooneadam":
+        from .zoadam import zero_one_adam
+        return zero_one_adam(lr if lr is not None else 1e-3, tuple(betas),
+                             **params)
     if name_l == "onebitlamb":
-        raise NotImplementedError(
-            "onebit_lamb is not implemented yet — use onebit_adam")
-    return onebit_adam(
-        lr if lr is not None else 1e-3, tuple(betas),
-        params.pop("eps", 1e-8), params.pop("weight_decay", 0.0),
-        params.pop("freeze_step", 100),
-        params.pop("comm_axis", "dcn_data"), variant=name_l)
+        from .lamb import onebit_lamb
+        return onebit_lamb(lr if lr is not None else 1e-3, tuple(betas),
+                           **params)
+    raise ValueError(f"unknown onebit optimizer {name}")

@@ -1,0 +1,167 @@
+"""1-bit LAMB — compressed-momentum LAMB with frozen layerwise coefficients.
+
+Role-equivalent of the reference ``OnebitLamb``
+(`/root/reference/deepspeed/runtime/fp16/onebit/lamb.py:13`). Warmup is
+exact LAMB (full-precision gradient averaging) while an EMA
+(``coeff_beta``) of each leaf's trust ratio is recorded; at the freeze
+boundary the variance is snapshotted (``exp_avg_sq_fresh``) and per-leaf
+``scaling_coeff`` = united_scale / RMS(momentum) equalize momentum
+magnitudes so the 1-bit collective's error feedback behaves uniformly
+across layers (reference lamb.py:170-185). In the compression phase the
+scaled momentum is 1-bit averaged; a *fresh* variance rebuilt from
+reconstructed gradients gives the scaling ``factor`` =
+max(frozen_denom / fresh_denom), clipped to [factor_min, factor_max] and
+rate-limited by ``factor_threshold``, and the applied trust ratio is
+``lamb_coeff_freeze * factor`` (lamb.py:330-385).
+
+TPU redesign: per-leaf tensors through the shard_map'd error-compensated
+collective (`runtime/comm/compressed.py`) instead of one flattened fused
+buffer — XLA already fuses the elementwise work, and per-leaf chunking is
+what the collective wants. All schedule state (scaling coeffs, EMA coeff,
+last factor) lives in the optimizer state tree as scalars, so the whole
+phase is one compiled program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...comm.compressed import compressed_allreduce
+from ...optimizers import _tmap, _unzip, _zeros_like_f32
+from .adam import OnebitOptimizer, make_init_errors
+
+
+def onebit_lamb(lr_default: float = 1e-3, betas=(0.9, 0.999),
+                eps: float = 1e-6, weight_decay: float = 0.0,
+                freeze_step: int = 100000,
+                max_coeff: float = 10.0, min_coeff: float = 0.01,
+                coeff_beta: float = 0.9,
+                factor_max: float = 4.0, factor_min: float = 0.5,
+                factor_threshold: float = 0.1,
+                comm_axis: str = "dcn_data",
+                **unused) -> OnebitOptimizer:
+    b1, b2 = betas
+
+    def init(params):
+        def scalar_tree(val):
+            return _tmap(lambda _: jnp.asarray(val, jnp.float32), params)
+        return {"step": jnp.zeros((), jnp.int32),
+                "m": _zeros_like_f32(params),
+                "v": _zeros_like_f32(params),
+                "v_fresh": _zeros_like_f32(params),
+                "coeff_freeze": scalar_tree(0.0),
+                "last_factor": scalar_tree(1.0),
+                "scaling_coeff": scalar_tree(1.0)}
+
+    init_errors = make_init_errors(comm_axis)
+
+    def _make_warmup(with_freeze: bool):
+        """Exact LAMB on pmean'd grads + trust-ratio EMA (reference
+        lamb.py:225-250). ``with_freeze`` is a STATIC flag — the
+        freeze-boundary extras (v→v_fresh snapshot, scaling coeffs from
+        momentum RMS, lamb.py:170-185) compile only into the one-shot
+        'freeze' program, not into every warmup step."""
+        def apply(grads, state, params, lr):
+            step = state["step"] + 1
+
+            def upd(g, m, v, p, cf):
+                g32 = jax.lax.pmean(g.astype(jnp.float32), comm_axis)
+                m_new = b1 * m + (1 - b1) * g32
+                v_new = b2 * v + (1 - b2) * g32 * g32
+                u = m_new / (jnp.sqrt(v_new) + eps)
+                p32 = p.astype(jnp.float32)
+                if weight_decay:
+                    u = u + weight_decay * p32
+                w_norm = jnp.linalg.norm(p32)
+                u_norm = jnp.linalg.norm(u)
+                coeff = jnp.where((w_norm > 0) & (u_norm > 0),
+                                  jnp.clip(w_norm / u_norm, min_coeff,
+                                           max_coeff), 1.0)
+                cf_new = coeff_beta * cf + (1 - coeff_beta) * coeff
+                return (p32 - lr * coeff * u).astype(p.dtype), m_new, \
+                    v_new, cf_new
+            out = _tmap(upd, grads, state["m"], state["v"], params,
+                        state["coeff_freeze"])
+            new_p, new_m, new_v, new_cf = _unzip(out, 4)
+            new_state = {**state, "step": step, "m": new_m, "v": new_v,
+                         "coeff_freeze": new_cf}
+            if with_freeze:
+                rms = _tmap(lambda m: jnp.linalg.norm(m) /
+                            jnp.sqrt(jnp.asarray(m.size, jnp.float32)),
+                            new_m)
+                rms_leaves = jax.tree_util.tree_leaves(rms)
+                united = sum(rms_leaves) / len(rms_leaves)
+                new_state["scaling_coeff"] = _tmap(
+                    lambda r: united / jnp.maximum(r, 1e-12), rms)
+                new_state["v_fresh"] = new_v
+            return new_p, new_state
+        return apply
+
+    warmup_apply = _make_warmup(False)
+    freeze_apply = _make_warmup(True)
+
+    def compress_apply(grads, state, params, lr, errors):
+        """Compressed phase (reference lamb.py:251-385)."""
+        step = state["step"] + 1
+        treedef = jax.tree_util.tree_structure(grads)
+        leaves = lambda t: jax.tree_util.tree_leaves(t)  # noqa: E731
+        out_p, out_m, out_vf, out_lf, out_we, out_se = ([], [], [], [], [],
+                                                        [])
+        for (g, m, v, vf, cf, lf, sc, p, we, se) in zip(
+                leaves(grads), leaves(state["m"]), leaves(state["v"]),
+                leaves(state["v_fresh"]), leaves(state["coeff_freeze"]),
+                leaves(state["last_factor"]), leaves(state["scaling_coeff"]),
+                leaves(params), leaves(errors["worker"]),
+                leaves(errors["server"])):
+            m_last = m
+            m_loc = (b1 * m + (1 - b1) * g.astype(jnp.float32)) * sc
+            m_avg, we2, se2 = compressed_allreduce(
+                m_loc, we[0], se[0], comm_axis)
+            m_new = m_avg / sc
+            g_rec = (m_new - m_last * b1) / (1 - b1)
+            vf_new = b2 * vf + (1 - b2) * g_rec * g_rec
+            denom = jnp.sqrt(v) + eps          # frozen variance
+            denom_real = jnp.sqrt(vf_new) + eps
+            update_prelim = m_new / denom
+            p32 = p.astype(jnp.float32)
+            if weight_decay:
+                update = update_prelim + weight_decay * p32
+            else:
+                update = update_prelim
+            factor = jnp.max(denom / denom_real)
+            if weight_decay:
+                ratio = jnp.minimum(
+                    1.0, jnp.linalg.norm(update_prelim) /
+                    jnp.maximum(jnp.linalg.norm(update), 1e-12))
+                factor = factor * ratio + (1.0 - ratio)
+            factor = jnp.clip(factor, factor_min, factor_max)
+            factor = jnp.clip(factor, lf * (1.0 - factor_threshold),
+                              lf * (1.0 + factor_threshold))
+            coeff = cf * factor
+            out_p.append((p32 - lr * coeff * update).astype(p.dtype))
+            out_m.append(m_new)
+            out_vf.append(vf_new)
+            out_lf.append(factor)
+            out_we.append(we2[None])
+            out_se.append(se2[None])
+        unf = lambda ls: jax.tree_util.tree_unflatten(treedef, ls)  # noqa
+        return (unf(out_p),
+                {"step": step, "m": unf(out_m), "v": state["v"],
+                 "v_fresh": unf(out_vf), "coeff_freeze":
+                     state["coeff_freeze"], "last_factor": unf(out_lf),
+                 "scaling_coeff": state["scaling_coeff"]},
+                {"worker": unf(out_we), "server": unf(out_se)})
+
+    return OnebitOptimizer(
+        name="onebitlamb", init=init, apply=warmup_apply,
+        hyperparams=dict(lr=lr_default, betas=betas, eps=eps,
+                         weight_decay=weight_decay,
+                         freeze_step=freeze_step, onebit=True),
+        compression_apply=compress_apply, init_errors=init_errors,
+        freeze_step=freeze_step, comm_axis=comm_axis, variant="onebitlamb",
+        programs={"warmup": (warmup_apply, False),
+                  "freeze": (freeze_apply, False),
+                  "compress": (compress_apply, True)},
+        program_key=lambda t: ("warmup" if t < freeze_step else
+                               "freeze" if t == freeze_step else
+                               "compress"))

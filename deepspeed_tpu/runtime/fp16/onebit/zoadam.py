@@ -1,0 +1,242 @@
+"""0/1 Adam — compressed communication AND intermittent communication.
+
+Role-equivalent of the reference ``ZeroOneAdam``
+(`/root/reference/deepspeed/runtime/fp16/onebit/zoadam.py:11`, the 0/1 Adam
+paper arXiv:2202.06009). Two orthogonal savings over 1-bit Adam:
+
+  * **variance freezing policy** (phase 1, step ≤ var_freeze_step): the
+    variance (and a full-precision gradient allreduce) updates only on an
+    exponentially sparsifying schedule — var_interval doubles after every
+    ``var_update_scaler`` variance updates. Off-schedule steps average the
+    GRADIENT through the 1-bit error-compensated collective.
+  * **local step policy** (phase 2, step > var_freeze_step): replicas take
+    purely LOCAL Adam steps, accumulating their updates in a momentum
+    accumulator; every ``local_step_interval`` steps one 1-bit allreduce
+    reconciles the accumulated update across replicas (and the interval
+    itself doubles every ``local_step_scaler`` steps, clipped to
+    ``local_step_clipper``) — communication becomes *intermittent*, not
+    just compressed.
+
+TPU redesign: the reference flips runtime flags
+(enable_backward_allreduce, freeze_key) on a live optimizer object; here
+each schedule mode is its own compiled program — "var" | "comp" | "local"
+| "sync" — and the host-side ``ZeroOneSchedule`` (a deterministic replay
+of the reference's var_counter/var_interval/local_step_counter state
+machine) picks the program per step. Error buffers re-zero when phase 2
+first activates (reference reinitial_error_buffer, `zoadam.py:324`).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...comm.compressed import compressed_allreduce
+from ...optimizers import _tmap, _unzip, _zeros_like_f32
+from .adam import OnebitOptimizer, make_init_errors
+
+
+class ZeroOneSchedule:
+    """Host mirror of the reference's per-step schedule state. ``key(t)``
+    must be called with 1-based consecutive steps (it fast-forwards if
+    called ahead, e.g. after checkpoint resume)."""
+
+    def __init__(self, var_freeze_step: int, var_update_scaler: int,
+                 local_step_scaler: int, local_step_clipper: int):
+        self.var_freeze_step = var_freeze_step
+        self.var_update_scaler = var_update_scaler
+        self.local_step_scaler = local_step_scaler
+        self.local_step_clipper = local_step_clipper
+        self._t = 0
+        self._last = None
+        self.var_interval = 1
+        self.var_counter = 0
+        self.local_interval = 1
+        self.local_counter = 0
+
+    def _advance(self) -> str:
+        self._t += 1
+        t = self._t
+        if t <= self.var_freeze_step:
+            if t % self.var_interval == 0:
+                # variance-update step (full-precision allreduce)
+                self.var_counter += 1
+                if self.var_counter == self.var_update_scaler:
+                    self.var_counter = 0
+                    self.var_interval *= 2
+                return "var"
+            return "comp"
+        # phase 2: the sync decision uses the CURRENT interval; the
+        # counter/doubling advances after (reference zoadam.py:233 check
+        # before the :298-303 counter block)
+        out = "sync" if t % self.local_interval == 0 else "local"
+        self.local_counter += 1
+        if self.local_counter == self.local_step_scaler:
+            self.local_counter = 0
+            self.local_interval = min(self.local_step_clipper,
+                                      self.local_interval * 2)
+        return out
+
+    def _reset(self) -> None:
+        self._t = 0
+        self._last = None
+        self.var_interval = 1
+        self.var_counter = 0
+        self.local_interval = 1
+        self.local_counter = 0
+
+    def key(self, t: int) -> str:
+        if t < 1:
+            raise ValueError(f"steps are 1-based, got {t}")
+        if t == self._t:
+            return self._last    # idempotent per step (engine may re-ask)
+        if t < self._t:
+            # checkpoint rollback: the schedule is pure host state —
+            # re-simulate from 0
+            self._reset()
+        k = None
+        while self._t < t:
+            k = self._advance()
+        self._last = k
+        return k
+
+
+def zero_one_adam(lr_default: float = 1e-3, betas=(0.9, 0.999),
+                  eps: float = 1e-8, weight_decay: float = 0.0,
+                  var_freeze_step: int = 100000,
+                  var_update_scaler: int = 16,
+                  local_step_scaler: int = 32678,
+                  local_step_clipper: int = 16,
+                  comm_axis: str = "dcn_data",
+                  **unused) -> OnebitOptimizer:
+    b1, b2 = betas
+
+    def init(params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "m": _zeros_like_f32(params),
+                "v": _zeros_like_f32(params),
+                # the u accumulator of the 0/1 paper (reference
+                # momentum_accumulator) + the lr sum over the local window
+                "u": _zeros_like_f32(params),
+                "lrs": jnp.zeros((), jnp.float32)}
+
+    init_errors = make_init_errors(comm_axis)
+
+    def _adam_update(m, v, p, lr):
+        u = m / (jnp.sqrt(v) + eps)
+        p32 = p.astype(jnp.float32)
+        if weight_decay:
+            u = u + weight_decay * p32
+        return u, (p32 - lr * u).astype(p.dtype)
+
+    # -- phase-1 programs --------------------------------------------------
+    def var_apply(grads, state, params, lr):
+        """Variance-update step: full-precision pmean of grads, both
+        moments update (reference zoadam.py:212-214)."""
+        step = state["step"] + 1
+
+        def upd(g, m, v, p):
+            g32 = jax.lax.pmean(g.astype(jnp.float32), comm_axis)
+            m_new = b1 * m + (1 - b1) * g32
+            v_new = b2 * v + (1 - b2) * g32 * g32
+            _, p_new = _adam_update(m_new, v_new, p, lr)
+            return p_new, m_new, v_new
+        out = _tmap(upd, grads, state["m"], state["v"], params)
+        new_p, new_m, new_v = _unzip(out, 3)
+        return new_p, {**state, "step": step, "m": new_m, "v": new_v}
+
+    def comp_apply(grads, state, params, lr, errors):
+        """Off-schedule phase-1 step: 1-bit allreduce of the GRADIENT,
+        momentum update only, variance frozen (zoadam.py:216-226)."""
+        step = state["step"] + 1
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        ms = jax.tree_util.tree_leaves(state["m"])
+        vs = jax.tree_util.tree_leaves(state["v"])
+        ps = jax.tree_util.tree_leaves(params)
+        wes = jax.tree_util.tree_leaves(errors["worker"])
+        ses = jax.tree_util.tree_leaves(errors["server"])
+        out_p, out_m, out_we, out_se = [], [], [], []
+        for g, m, v, p, we, se in zip(flat_g, ms, vs, ps, wes, ses):
+            g1, we2, se2 = compressed_allreduce(
+                g.astype(jnp.float32), we[0], se[0], comm_axis)
+            m_new = b1 * m + (1 - b1) * g1
+            _, p_new = _adam_update(m_new, v, p, lr)
+            out_p.append(p_new)
+            out_m.append(m_new)
+            out_we.append(we2[None])
+            out_se.append(se2[None])
+        unf = lambda ls: jax.tree_util.tree_unflatten(treedef, ls)  # noqa
+        return (unf(out_p), {**state, "step": step, "m": unf(out_m)},
+                {"worker": unf(out_we), "server": unf(out_se)})
+
+    # -- phase-2 programs --------------------------------------------------
+    def local_apply(grads, state, params, lr):
+        """Local step: no communication; the update also accumulates into
+        u (zoadam.py:228-257 freeze_key branch)."""
+        step = state["step"] + 1
+
+        def upd(g, m, v, p, u_acc):
+            m_new = b1 * m + (1 - b1) * g.astype(jnp.float32)
+            upd_, p_new = _adam_update(m_new, v, p, lr)
+            return p_new, m_new, u_acc - lr * upd_
+        out = _tmap(upd, grads, state["m"], state["v"], params, state["u"])
+        new_p, new_m, new_u = _unzip(out, 3)
+        return new_p, {**state, "step": step, "m": new_m, "u": new_u,
+                       "lrs": state["lrs"] + lr}
+
+    def sync_apply(grads, state, params, lr, errors):
+        """Local step + reconciliation: roll back the locally-accumulated
+        update, 1-bit-average the accumulator (descaled by the frozen
+        denominator), reapply averaged, reconstruct momentum as -u/lrs
+        (zoadam.py:257-276)."""
+        step = state["step"] + 1
+        lrs = state["lrs"] + lr
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        ms = jax.tree_util.tree_leaves(state["m"])
+        vs = jax.tree_util.tree_leaves(state["v"])
+        ps = jax.tree_util.tree_leaves(params)
+        us = jax.tree_util.tree_leaves(state["u"])
+        wes = jax.tree_util.tree_leaves(errors["worker"])
+        ses = jax.tree_util.tree_leaves(errors["server"])
+        out_p, out_m, out_u, out_we, out_se = [], [], [], [], []
+        for g, m, v, p, u_acc, we, se in zip(flat_g, ms, vs, ps, us, wes,
+                                             ses):
+            # the step's own local update first (reference order)
+            m_loc = b1 * m + (1 - b1) * g.astype(jnp.float32)
+            upd_, p_loc = _adam_update(m_loc, v, p, lr)
+            u_new = u_acc - lr * upd_
+            denom = jnp.sqrt(v) + eps
+            # roll back this window's local updates, average the window
+            p32 = p_loc.astype(jnp.float32) - u_new
+            u_scaled = u_new * denom
+            u_avg, we2, se2 = compressed_allreduce(
+                u_scaled, we[0], se[0], comm_axis)
+            m_rec = -u_avg / lrs
+            p_new = (p32 + u_avg / denom).astype(p.dtype)
+            out_p.append(p_new)
+            out_m.append(m_rec)
+            out_u.append(jnp.zeros_like(u_new))
+            out_we.append(we2[None])
+            out_se.append(se2[None])
+        unf = lambda ls: jax.tree_util.tree_unflatten(treedef, ls)  # noqa
+        return (unf(out_p),
+                {**state, "step": step, "m": unf(out_m), "u": unf(out_u),
+                 "lrs": jnp.zeros((), jnp.float32)},
+                {"worker": unf(out_we), "server": unf(out_se)})
+
+    sched = ZeroOneSchedule(var_freeze_step, var_update_scaler,
+                            local_step_scaler, local_step_clipper)
+    return OnebitOptimizer(
+        name="zerooneadam", init=init, apply=var_apply,
+        hyperparams=dict(lr=lr_default, betas=betas, eps=eps,
+                         weight_decay=weight_decay,
+                         freeze_step=var_freeze_step, onebit=True),
+        compression_apply=comp_apply, init_errors=init_errors,
+        freeze_step=var_freeze_step, comm_axis=comm_axis,
+        variant="zerooneadam",
+        programs={"var": (var_apply, False), "comp": (comp_apply, True),
+                  "local": (local_apply, False),
+                  "sync": (sync_apply, True)},
+        program_key=sched.key,
+        reset_errors_on=("local", "sync"))

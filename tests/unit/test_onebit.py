@@ -95,36 +95,39 @@ class TestCompressedAllreduce:
                         jnp.zeros((8, 1)))
 
 
+
+def _train(opt_cfg, mesh, n=6, seed=0):
+    engine, _, _, _ = ds.initialize(model=tiny_model(), config={
+        "train_batch_size": 32, "gradient_accumulation_steps": 2,
+        "optimizer": opt_cfg, "mesh": mesh, "steps_per_print": 0,
+    }, rng=jax.random.PRNGKey(seed))
+    return engine, [float(engine.train_step(
+        batch(32, seed=i))["loss"]) for i in range(n)]
+
+
 class TestOnebitAdamEngine:
-    def _train(self, opt_cfg, mesh, n=6, seed=0):
-        engine, _, _, _ = ds.initialize(model=tiny_model(), config={
-            "train_batch_size": 32, "gradient_accumulation_steps": 2,
-            "optimizer": opt_cfg, "mesh": mesh, "steps_per_print": 0,
-        }, rng=jax.random.PRNGKey(seed))
-        return engine, [float(engine.train_step(
-            batch(32, seed=i))["loss"]) for i in range(n)]
 
     def test_warmup_matches_plain_adam(self):
         """During warmup 1-bit Adam IS Adam (exact pmean) — loss
         trajectories must match the plain engine."""
         # reference OnebitAdam applies NO bias correction in either phase
-        _, ref = self._train(
+        _, ref = _train(
             {"type": "AdamW", "params": {"lr": 1e-3, "adam_w_mode": False,
                                          "bias_correction": False}},
             {"data": 8}, n=3)
-        _, ob = self._train(
+        _, ob = _train(
             {"type": "OnebitAdam", "params": {"lr": 1e-3,
                                               "freeze_step": 100}},
             {"dcn_data": 2, "data": 4}, n=3)
         np.testing.assert_allclose(ref, ob, rtol=2e-4)
 
     def test_compression_phase_trains(self):
-        engine, losses = self._train(
+        engine, losses = _train(
             {"type": "OnebitAdam", "params": {"lr": 1e-3,
                                               "freeze_step": 2}},
             {"dcn_data": 2, "data": 4}, n=8)
         assert all(np.isfinite(losses))
-        assert engine._onebit_phase is True          # switched programs
+        assert engine._onebit_key == "compress"      # switched programs
         # compression must not destabilize training (random data: exact
         # descent is noise; divergence would blow past this band)
         assert losses[-1] < losses[0] + 0.05
@@ -132,11 +135,11 @@ class TestOnebitAdamEngine:
     def test_convergence_parity_with_adam(self):
         """End-to-end: 1-bit (freeze 3) final loss within 2% of Adam's
         after 10 steps (reference onebit convergence tests)."""
-        _, ref = self._train(
+        _, ref = _train(
             {"type": "AdamW", "params": {"lr": 1e-3, "adam_w_mode": False,
                                          "bias_correction": False}},
             {"data": 8}, n=10)
-        _, ob = self._train(
+        _, ob = _train(
             {"type": "OnebitAdam", "params": {"lr": 1e-3,
                                               "freeze_step": 3}},
             {"dcn_data": 2, "data": 4}, n=10)
@@ -152,3 +155,106 @@ class TestOnebitAdamEngine:
                 "mesh": {"dcn_data": 2, "data": 4},
                 "steps_per_print": 0})
             engine.train_step(batch(32))
+
+
+class TestZeroOneSchedule:
+    def test_replays_reference_state_machine(self):
+        from deepspeed_tpu.runtime.fp16.onebit.zoadam import ZeroOneSchedule
+        s = ZeroOneSchedule(var_freeze_step=10, var_update_scaler=2,
+                            local_step_scaler=4, local_step_clipper=4)
+        keys = [s.key(t) for t in range(1, 21)]
+        # var_interval starts 1: steps 1,2 are var (counter hits 2 -> interval 2)
+        assert keys[0] == "var" and keys[1] == "var"
+        # interval 2: step 3 comp, step 4 var ...
+        assert keys[2] == "comp" and keys[3] == "var"
+        # after var_freeze_step=10: phase 2
+        assert set(keys[10:]) <= {"local", "sync"}
+        # local_interval doubles every local_step_scaler=4 steps, clipped at 4
+        assert "sync" in keys[10:]
+
+    def test_idempotent_per_step_and_rollback(self):
+        from deepspeed_tpu.runtime.fp16.onebit.zoadam import ZeroOneSchedule
+        s = ZeroOneSchedule(5, 2, 4, 4)
+        assert s.key(1) == s.key(1)
+        with pytest.raises(ValueError):
+            s.key(0)
+        # checkpoint rollback: resimulates from 0 and agrees with a fresh
+        # schedule
+        s.key(20)
+        rolled = [s.key(t) for t in range(3, 8)]
+        fresh = ZeroOneSchedule(5, 2, 4, 4)
+        fresh.key(2)
+        assert rolled == [fresh.key(t) for t in range(3, 8)]
+
+
+class TestZeroOneAdamEngine:
+    def test_var_phase_matches_plain_adam(self):
+        """With var_interval stuck at 1 (huge var_update_scaler), every
+        phase-1 step is a full-precision variance update == exact Adam."""
+        _, ref = _train(
+            {"type": "AdamW", "params": {"lr": 1e-3, "adam_w_mode": False,
+                                         "bias_correction": False}},
+            {"data": 8}, n=3)
+        _, zo = _train(
+            {"type": "ZeroOneAdam", "params": {
+                "lr": 1e-3, "var_freeze_step": 100,
+                "var_update_scaler": 10000}},
+            {"dcn_data": 2, "data": 4}, n=3)
+        np.testing.assert_allclose(ref, zo, rtol=2e-4)
+
+    def test_all_four_programs_run_and_train(self):
+        engine, losses = _train(
+            {"type": "ZeroOneAdam", "params": {
+                "lr": 1e-3, "var_freeze_step": 4, "var_update_scaler": 2,
+                "local_step_scaler": 2, "local_step_clipper": 4}},
+            {"dcn_data": 2, "data": 4}, n=10)
+        assert all(np.isfinite(losses))
+        # engine compiled several distinct phase programs
+        assert set(engine._onebit_compiled) >= {"var", "comp", "local",
+                                               "sync"}
+        assert losses[-1] < losses[0] + 0.05
+        assert engine._onebit_errors_reset  # buffers re-zeroed at phase 2
+
+    def test_compression_reduces_wire_traffic(self):
+        """0/1 Adam's whole point: most steps are local/comp, few are
+        full-precision var steps."""
+        from deepspeed_tpu.runtime.fp16.onebit.zoadam import ZeroOneSchedule
+        s = ZeroOneSchedule(var_freeze_step=64, var_update_scaler=2,
+                            local_step_scaler=8, local_step_clipper=8)
+        keys = [s.key(t) for t in range(1, 129)]
+        full_precision = sum(k == "var" for k in keys)
+        comm_free = sum(k == "local" for k in keys)
+        assert full_precision < 20      # exponentially sparsifying
+        assert comm_free > 40           # most phase-2 steps are local
+
+
+class TestOnebitLambEngine:
+    def test_warmup_matches_plain_lamb(self):
+        _, ref = _train(
+            {"type": "Lamb", "params": {"lr": 1e-3}},
+            {"data": 8}, n=3)
+        _, ob = _train(
+            {"type": "OnebitLamb", "params": {"lr": 1e-3,
+                                              "freeze_step": 100}},
+            {"dcn_data": 2, "data": 4}, n=3)
+        np.testing.assert_allclose(ref, ob, rtol=2e-4)
+
+    def test_compression_phase_trains(self):
+        engine, losses = _train(
+            {"type": "OnebitLamb", "params": {"lr": 1e-3,
+                                              "freeze_step": 2}},
+            {"dcn_data": 2, "data": 4}, n=8)
+        assert all(np.isfinite(losses))
+        assert engine._onebit_key == "compress"
+        assert losses[-1] < losses[0] + 0.05
+
+    def test_scaling_coeffs_set_at_freeze(self):
+        engine, _ = _train(
+            {"type": "OnebitLamb", "params": {"lr": 1e-3,
+                                              "freeze_step": 2}},
+            {"dcn_data": 2, "data": 4}, n=4)
+        sc = jax.tree_util.tree_leaves(
+            engine.state["opt"]["scaling_coeff"])
+        vals = np.asarray([float(x) for x in sc])
+        assert (vals != 1.0).any()          # coeffs engaged at freeze
+        assert np.isfinite(vals).all() and (vals > 0).all()
