@@ -58,7 +58,12 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16          # activation dtype
     param_dtype: Any = jnp.float32
     remat: str = "none"                # none | full | dots_saveable | nothing_saveable
-    attn_impl: str = "xla"             # xla | flash (pallas)
+    attn_impl: str = "xla"             # xla | flash | ring | blocksparse
+    # attn_impl="blocksparse": an ops.sparse_attention.SparsityConfig
+    # (Fixed/LocalSlidingWindow/BigBird/BSLongformer/Variable) — the layout
+    # drives the Pallas block-sparse flash kernel
+    # (ops/sparse_attention/blocksparse_flash.py)
+    sparsity_config: Any = None
     layernorm_eps: float = 1e-5
     # Chunked cross-entropy: the [B,T,V] logits tensor is the largest HBM
     # object at vocab 50k; computing the loss in sequence chunks of this many
@@ -282,6 +287,7 @@ class TransformerLM:
         self.mesh = mesh
 
     _flash_fallback_warned = False
+    _blocksparse_decode_warned = False
 
     def _warn_flash_fallback(self, tq: int, tk: int) -> None:
         """Loud (once) on the flash→XLA perf cliff — a silent fallback hides
@@ -322,6 +328,17 @@ class TransformerLM:
             o = ring_attention(q, k, v, self.mesh)
             o = o.reshape(b, t, nh * hd)
             return L.dense_apply(p["out"], o), None
+        if cache_kv is None and c.attn_impl == "blocksparse":
+            from ..ops.sparse_attention.blocksparse_flash import (
+                blocksparse_attention_bthd)
+            if c.sparsity_config is None:
+                raise ValueError(
+                    "attn_impl='blocksparse' needs sparsity_config (an "
+                    "ops.sparse_attention.SparsityConfig instance) on the "
+                    "TransformerConfig")
+            o = blocksparse_attention_bthd(q, k, v, c.sparsity_config)
+            o = o.reshape(b, t, nh * hd)
+            return L.dense_apply(p["out"], o), None
         if cache_kv is None and c.attn_impl == "flash":
             from ..ops.transformer.flash_attention import (
                 flash_attention_bthd, supports)
@@ -331,6 +348,16 @@ class TransformerLM:
                 return L.dense_apply(p["out"], o), None
             self._warn_flash_fallback(q.shape[1], k.shape[1])
         if cache_kv is not None:
+            if c.attn_impl == "blocksparse" and \
+                    not TransformerLM._blocksparse_decode_warned:
+                from ..utils.logging import logger
+                logger.warning(
+                    "attn_impl='blocksparse' decodes with DENSE causal "
+                    "attention over the KV cache — every token sees full "
+                    "history, unlike the sparse pattern used in training. "
+                    "Expect degraded generations for window-limited "
+                    "layouts; a sparse decode path is not built yet.")
+                TransformerLM._blocksparse_decode_warned = True
             ck, cv, idx = cache_kv
             ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
                                               (0, idx, 0, 0))

@@ -1,5 +1,7 @@
 """Block-sparse attention — counterpart of
 `/root/reference/deepspeed/ops/sparse_attention/`."""
+from .blocksparse_flash import (blocksparse_attention,
+                                blocksparse_attention_bthd, compress_layout)
 from .sparse_self_attention import SparseSelfAttention
 from .sparsity_config import (BigBirdSparsityConfig,
                               BSLongformerSparsityConfig,
@@ -7,7 +9,8 @@ from .sparsity_config import (BigBirdSparsityConfig,
                               LocalSlidingWindowSparsityConfig,
                               SparsityConfig, VariableSparsityConfig)
 
-__all__ = ["SparseSelfAttention", "SparsityConfig", "DenseSparsityConfig",
+__all__ = ["blocksparse_attention", "blocksparse_attention_bthd",
+           "compress_layout", "SparseSelfAttention", "SparsityConfig", "DenseSparsityConfig",
            "FixedSparsityConfig", "VariableSparsityConfig",
            "BigBirdSparsityConfig", "BSLongformerSparsityConfig",
            "LocalSlidingWindowSparsityConfig"]
