@@ -1,6 +1,16 @@
 """Compression — counterpart of `/root/reference/deepspeed/compression/`."""
-from .compress import (WeightQuantizeConfig, bits_at_step, compress_params,
-                       init_compression, post_training_quantize)
+from .compress import (ActivationQuantConfig, CompressionConfig,
+                       HeadPruningConfig, LayerReductionConfig, PruningGroup,
+                       RowPruningConfig, SparsePruningConfig,
+                       WeightQuantizeConfig, apply_layer_reduction,
+                       bits_at_step, compress_params, init_compression,
+                       init_compression_model, parse_compression_config,
+                       post_training_quantize, redundancy_clean, topk_mask)
 
-__all__ = ["WeightQuantizeConfig", "bits_at_step", "compress_params",
-           "init_compression", "post_training_quantize"]
+__all__ = ["ActivationQuantConfig", "CompressionConfig", "HeadPruningConfig",
+           "LayerReductionConfig", "PruningGroup", "RowPruningConfig",
+           "SparsePruningConfig", "WeightQuantizeConfig",
+           "apply_layer_reduction", "bits_at_step", "compress_params",
+           "init_compression", "init_compression_model",
+           "parse_compression_config", "post_training_quantize",
+           "redundancy_clean", "topk_mask"]

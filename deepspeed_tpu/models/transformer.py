@@ -64,6 +64,11 @@ class TransformerConfig:
     # drives the Pallas block-sparse flash kernel
     # (ops/sparse_attention/blocksparse_flash.py)
     sparsity_config: Any = None
+    # activation quantization seam (compression/compress.py
+    # init_compression_model): fake-quantize the inputs of the qkv and
+    # fc_in projections with STE. 0 = off.
+    act_quant_bits: int = 0
+    act_quant_symmetric: bool = False
     layernorm_eps: float = 1e-5
     # Chunked cross-entropy: the [B,T,V] logits tensor is the largest HBM
     # object at vocab 50k; computing the loss in sequence chunks of this many
@@ -301,11 +306,20 @@ class TransformerLM:
                 f"to a multiple of the flash block for the fast path.")
             TransformerLM._flash_fallback_warned = True
 
+    def _maybe_qact(self, x):
+        """Activation-quantization seam (compression subsystem): STE
+        fake-quant on dense-projection inputs when act_quant_bits is set."""
+        c = self.config
+        if not c.act_quant_bits:
+            return x
+        from ..ops.quantizer.quantizer import fake_quantize
+        return fake_quantize(x, c.act_quant_bits, 1, c.act_quant_symmetric)
+
     # -- block -------------------------------------------------------------
     def _attention(self, p, x, cache_kv=None, positions=None):
         c = self.config
         nh, hd = c.num_heads, c.hdim
-        qkv = L.dense_apply(p["qkv"], x)
+        qkv = L.dense_apply(p["qkv"], self._maybe_qact(x))
         b, t = qkv.shape[0], qkv.shape[1]
         qkv = qkv.reshape(b, t, 3, nh, hd)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
@@ -386,7 +400,7 @@ class TransformerLM:
         return L.dense_apply(p["out"], o), new_cache
 
     def _mlp(self, p, x):
-        h = L.dense_apply(p["fc_in"], x)
+        h = L.dense_apply(p["fc_in"], self._maybe_qact(x))
         h = L.ACT_FNS[self.config.activation](h)
         return L.dense_apply(p["fc_out"], h)
 

@@ -1,0 +1,220 @@
+"""Compression techniques beyond weight quantization (VERDICT r2 #5).
+
+Reference coverage model: `/root/reference/tests/unit/compression/
+test_compression.py` (per-technique enable + forward correctness).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression import (apply_layer_reduction,
+                                       compress_params,
+                                       init_compression,
+                                       parse_compression_config,
+                                       redundancy_clean, topk_mask)
+from deepspeed_tpu.models import TransformerLM, gpt2_config
+
+
+def tiny_model(**kw):
+    return TransformerLM(gpt2_config(
+        "125m", num_layers=4, d_model=64, num_heads=4, vocab_size=64,
+        max_seq_len=32, loss_chunk=0, dtype=jnp.float32, **kw))
+
+
+def batch(n=4, seed=0):
+    rs = np.random.RandomState(seed)
+    return {"input_ids": rs.randint(0, 64, (n, 32), dtype=np.int32)}
+
+
+class TestParsing:
+    def test_reference_nested_schema(self):
+        cfg = parse_compression_config({
+            "sparse_pruning": {
+                "shared_parameters": {"enabled": True, "method": "l1",
+                                      "schedule_offset": 10},
+                "different_groups": {
+                    "sp1": {"params": {"dense_ratio": 0.5},
+                            "modules": ["blocks.*fc_in.*"]}}},
+            "row_pruning": {
+                "shared_parameters": {"enabled": True, "method": "l1"},
+                "different_groups": {
+                    "rp1": {"params": {"dense_ratio": 0.75}}}},
+            "head_pruning": {
+                "shared_parameters": {"enabled": True, "num_heads": 4},
+                "different_groups": {
+                    "hp1": {"params": {"dense_ratio": 0.5}}}},
+            "activation_quantization": {
+                "shared_parameters": {"enabled": True,
+                                      "quantization_type": "symmetric"},
+                "different_groups": {
+                    "aq1": {"params": {"bits": 8}}}},
+            "layer_reduction": {"enabled": True, "keep_number_layer": 2,
+                                "teacher_layer": [0, 3]},
+        })
+        assert cfg.sparse_pruning.enabled
+        assert cfg.sparse_pruning.schedule_offset == 10
+        assert cfg.sparse_pruning.groups[0].dense_ratio == 0.5
+        assert cfg.row_pruning.groups[0].dense_ratio == 0.75
+        assert cfg.head_pruning.num_heads == 4
+        assert cfg.activation_quantization.bits == 8
+        assert cfg.layer_reduction.teacher_layer == (0, 3)
+
+    def test_topk_and_channel_reject_loudly(self):
+        with pytest.raises(NotImplementedError, match="topk"):
+            parse_compression_config({
+                "sparse_pruning": {"shared_parameters": {
+                    "enabled": True, "method": "topk"},
+                    "different_groups": {}}})
+        with pytest.raises(NotImplementedError, match="channel"):
+            parse_compression_config({
+                "channel_pruning": {"shared_parameters": {
+                    "enabled": True}}})
+
+    def test_static_act_range_rejects(self):
+        with pytest.raises(NotImplementedError, match="static"):
+            parse_compression_config({
+                "activation_quantization": {"shared_parameters": {
+                    "enabled": True, "range_calibration": "static"}}})
+
+
+class TestMasks:
+    def test_topk_mask_keeps_ratio(self):
+        x = jnp.arange(100.0)
+        m = np.asarray(topk_mask(x, 0.3))
+        assert m.sum() == 30
+        assert (m[-30:] == 1).all()
+
+    def test_sparse_pruning_zeroes_weights(self):
+        model = tiny_model()
+        params = model.init(jax.random.PRNGKey(0))
+        cfg = parse_compression_config({
+            "sparse_pruning": {"shared_parameters": {"enabled": True},
+                               "different_groups": {"g": {
+                                   "params": {"dense_ratio": 0.25}}}}})
+        out = compress_params(params, cfg, jnp.asarray(0))
+        k = np.asarray(out["blocks"]["mlp"]["fc_in"]["kernel"])
+        frac = (k == 0).mean()
+        assert 0.7 < frac < 0.8          # 75% pruned
+
+    def test_row_pruning_structured(self):
+        model = tiny_model()
+        params = model.init(jax.random.PRNGKey(0))
+        cfg = parse_compression_config({
+            "row_pruning": {"shared_parameters": {"enabled": True},
+                            "different_groups": {"g": {
+                                "params": {"dense_ratio": 0.5}}}}})
+        out = compress_params(params, cfg, jnp.asarray(0))
+        k = np.asarray(out["blocks"]["mlp"]["fc_in"]["kernel"])  # [L,d,f]
+        col_zero = (k == 0).all(axis=1)          # [L, f]
+        assert abs(col_zero.mean() - 0.5) < 0.05  # half the features gone
+
+    def test_head_pruning_whole_heads(self):
+        model = tiny_model()
+        params = model.init(jax.random.PRNGKey(0))
+        cfg = parse_compression_config({
+            "head_pruning": {"shared_parameters": {"enabled": True,
+                                                   "num_heads": 4},
+                             "different_groups": {"g": {
+                                 "params": {"dense_ratio": 0.5}}}}})
+        out = compress_params(params, cfg, jnp.asarray(0))
+        k = np.asarray(out["blocks"]["attn"]["out"]["kernel"])  # [L,nh*hd,d]
+        L, nhd, d = k.shape
+        per_head = (k.reshape(L, 4, nhd // 4, d) == 0).all(axis=(2, 3))
+        assert (per_head.sum(axis=1) == 2).all()  # exactly 2 heads/layer
+
+    def test_schedule_offset_gates(self):
+        model = tiny_model()
+        params = model.init(jax.random.PRNGKey(0))
+        cfg = parse_compression_config({
+            "sparse_pruning": {"shared_parameters": {"enabled": True,
+                                                     "schedule_offset": 50},
+                               "different_groups": {"g": {
+                                   "params": {"dense_ratio": 0.25}}}}})
+        before = compress_params(params, cfg, jnp.asarray(10))
+        k = np.asarray(before["blocks"]["mlp"]["fc_in"]["kernel"])
+        assert (k == 0).mean() < 0.01            # not yet active
+        after = compress_params(params, cfg, jnp.asarray(60))
+        k = np.asarray(after["blocks"]["mlp"]["fc_in"]["kernel"])
+        assert (k == 0).mean() > 0.7
+
+
+class TestTraining:
+    def test_prune_then_finetune_converges(self):
+        import deepspeed_tpu as ds
+        model = tiny_model()
+        loss_fn = init_compression(model, {
+            "sparse_pruning": {"shared_parameters": {"enabled": True},
+                               "different_groups": {"g": {
+                                   "params": {"dense_ratio": 0.5}}}}})
+        engine, _, _, _ = ds.initialize(
+            model=model, config={
+                "train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+                "mesh": {"data": 8}, "steps_per_print": 0},
+            loss_fn=lambda p, b: loss_fn(p, b, 0))
+        losses = [float(engine.train_step(batch(8))["loss"])
+                  for _ in range(8)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0] - 0.2      # trains THROUGH the masks
+        cleaned = redundancy_clean(
+            engine.state["params"], {
+                "sparse_pruning": {"shared_parameters": {"enabled": True},
+                                   "different_groups": {"g": {
+                                       "params": {"dense_ratio": 0.5}}}}})
+        k = np.asarray(jax.device_get(
+            cleaned["blocks"]["mlp"]["fc_in"]["kernel"]))
+        assert 0.45 < (k == 0).mean() < 0.55
+
+    def test_activation_quant_trains(self):
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.compression import init_compression_model, \
+            parse_compression_config
+        model = init_compression_model(tiny_model(),
+                                       parse_compression_config({
+                                           "activation_quantization": {
+                                               "enabled": True,
+                                               "bits": 8}}))
+        assert model.config.act_quant_bits == 8
+        engine, _, _, _ = ds.initialize(model=model, config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+            "mesh": {"data": 8}, "steps_per_print": 0})
+        losses = [float(engine.train_step(batch(8))["loss"])
+                  for _ in range(6)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+
+class TestLayerReduction:
+    def test_student_from_teacher_layers(self):
+        from deepspeed_tpu.compression import LayerReductionConfig
+        teacher = tiny_model()
+        params = teacher.init(jax.random.PRNGKey(0))
+        student, sp = apply_layer_reduction(
+            teacher, params,
+            LayerReductionConfig(enabled=True, keep_number_layer=2,
+                                 teacher_layer=(0, 3)))
+        assert student.config.num_layers == 2
+        np.testing.assert_array_equal(
+            np.asarray(sp["blocks"]["mlp"]["fc_in"]["kernel"][0]),
+            np.asarray(params["blocks"]["mlp"]["fc_in"]["kernel"][0]))
+        np.testing.assert_array_equal(
+            np.asarray(sp["blocks"]["mlp"]["fc_in"]["kernel"][1]),
+            np.asarray(params["blocks"]["mlp"]["fc_in"]["kernel"][3]))
+        # student forward runs
+        out = student.loss(sp, batch(2))
+        assert np.isfinite(float(out))
+
+    def test_even_spacing_default(self):
+        from deepspeed_tpu.compression import LayerReductionConfig
+        teacher = tiny_model()
+        params = teacher.init(jax.random.PRNGKey(0))
+        student, sp = apply_layer_reduction(
+            teacher, params, LayerReductionConfig(enabled=True,
+                                                  keep_number_layer=2))
+        assert student.config.num_layers == 2
+        np.testing.assert_array_equal(
+            np.asarray(sp["blocks"]["ln1"]["scale"][1]),
+            np.asarray(params["blocks"]["ln1"]["scale"][3]))
