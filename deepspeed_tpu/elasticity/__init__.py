@@ -1,6 +1,8 @@
 """Elastic training — counterpart of `/root/reference/deepspeed/elasticity/`."""
+from .elastic_agent import AgentResult, ElasticAgent, WorkerSpec
 from .elasticity import (ElasticityError, ElasticityIncompatibleWorldSize,
                          compute_elastic_config)
 
-__all__ = ["compute_elastic_config", "ElasticityError",
+__all__ = ["AgentResult", "ElasticAgent", "WorkerSpec",
+           "compute_elastic_config", "ElasticityError",
            "ElasticityIncompatibleWorldSize"]

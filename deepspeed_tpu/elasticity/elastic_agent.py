@@ -1,0 +1,151 @@
+"""Elastic agent: worker monitoring + re-rendezvous at a valid world size.
+
+Role-equivalent of the reference ``DSElasticAgent``
+(`/root/reference/deepspeed/elasticity/elastic_agent.py:23`, riding
+torch.distributed.elastic's monitor/restart loop at :115): launch the
+worker group, watch it, and when membership changes (a worker dies),
+re-rendezvous the SURVIVORS at the largest world size the elasticity
+config admits, then relaunch.
+
+TPU redesign: no torch rendezvous store — the agent is the single
+controller of its node-local worker group (the launcher model of
+`launcher/runner.py`), worker generations get their coordinates purely
+through env (WORLD_SIZE/RANK/ELASTIC_RESTART_COUNT), and the valid world
+sizes come from the same v0.1/v0.2 solver the schedule uses
+(`elasticity/elasticity.py` compute_elastic_config) — so a shrink always
+lands on a world size whose batch configuration is legal.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import subprocess
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import logger
+from .elasticity import ElasticityError, compute_elastic_config
+
+
+@dataclasses.dataclass
+class WorkerSpec:
+    """What to run: argv for one worker; env gets the coordinates."""
+    argv: Sequence[str]
+    env: Optional[Dict[str, str]] = None
+    cwd: Optional[str] = None
+
+
+@dataclasses.dataclass
+class AgentResult:
+    success: bool
+    final_world_size: int
+    generations: int          # rendezvous count (1 = no failures)
+    failed_slots: int
+    return_codes: List[int]
+
+
+class ElasticAgent:
+    """Monitor/restart loop over a node-local worker group."""
+
+    def __init__(self, spec: WorkerSpec, ds_config: Dict,
+                 initial_world_size: int,
+                 monitor_interval: float = 0.2,
+                 max_restarts: int = 3,
+                 on_rendezvous: Optional[Callable[[int, int], None]] = None):
+        self.spec = spec
+        self.ds_config = ds_config
+        self.initial_world = int(initial_world_size)
+        self.monitor_interval = monitor_interval
+        self.max_restarts = max_restarts
+        self.on_rendezvous = on_rendezvous
+        # validate config up front (loud reject beats dying mid-training)
+        _, self.valid_worlds = compute_elastic_config(
+            ds_config, world_size=0)
+
+    # -- world-size policy -------------------------------------------------
+    def next_world_size(self, slots: int) -> Optional[int]:
+        """Largest valid world size ≤ surviving slots (reference: the
+        rendezvous settles on the biggest admissible group)."""
+        fits = [w for w in self.valid_worlds if w <= slots]
+        return max(fits) if fits else None
+
+    # -- worker group ------------------------------------------------------
+    def _launch(self, world: int, generation: int
+                ) -> List[subprocess.Popen]:
+        procs = []
+        for rank in range(world):
+            env = dict(os.environ)
+            env.update(self.spec.env or {})
+            env.update({
+                "WORLD_SIZE": str(world),
+                "RANK": str(rank),
+                "LOCAL_RANK": str(rank),
+                "ELASTIC_RESTART_COUNT": str(generation - 1),
+            })
+            procs.append(subprocess.Popen(
+                list(self.spec.argv), env=env, cwd=self.spec.cwd))
+        logger.info(f"elastic agent: generation {generation} launched "
+                    f"world_size={world}")
+        return procs
+
+    @staticmethod
+    def _kill(procs: List[subprocess.Popen]) -> None:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 5
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    # -- the loop ----------------------------------------------------------
+    def run(self) -> AgentResult:
+        slots = self.initial_world
+        world = self.next_world_size(slots)
+        if world is None:
+            raise ElasticityError(
+                f"no valid world size ≤ {slots}; valid set: "
+                f"{self.valid_worlds}")
+        generation = 0
+        restarts = 0
+        failed_slots = 0
+        while True:
+            generation += 1
+            if self.on_rendezvous:
+                self.on_rendezvous(generation, world)
+            procs = self._launch(world, generation)
+            failed = False
+            while True:
+                codes = [p.poll() for p in procs]
+                if any(c is not None and c != 0 for c in codes):
+                    failed = True
+                    break
+                if all(c == 0 for c in codes):
+                    return AgentResult(True, world, generation,
+                                       failed_slots,
+                                       [p.returncode for p in procs])
+                time.sleep(self.monitor_interval)
+            # membership change: a worker died — kill survivors,
+            # re-rendezvous at the largest valid smaller world
+            n_dead = sum(1 for c in codes if c is not None and c != 0)
+            failed_slots += n_dead
+            logger.warning(
+                f"elastic agent: {n_dead} worker(s) failed in generation "
+                f"{generation} (codes {codes}); re-rendezvous")
+            self._kill(procs)
+            slots -= n_dead
+            restarts += 1
+            if restarts > self.max_restarts:
+                return AgentResult(False, world, generation, failed_slots,
+                                   [p.returncode for p in procs])
+            world = self.next_world_size(slots)
+            if world is None:
+                logger.error(
+                    f"elastic agent: surviving slots {slots} admit no "
+                    f"valid world size (valid: {self.valid_worlds})")
+                return AgentResult(False, 0, generation, failed_slots,
+                                   [p.returncode for p in procs])

@@ -43,6 +43,11 @@ class DeepSpeedInferenceConfig(ConfigModel):
     # bounds the preallocated cache)
     max_out_tokens: int = 1024
     max_batch_size: int = 16
+    # Serving shape policy: prompts are right-padded up to a multiple of
+    # this bucket so varied prompt lengths reuse ONE compiled program per
+    # bucket instead of recompiling per exact length (the true length is a
+    # dynamic argument). 0 = exact shapes (compile per length).
+    prompt_bucket: int = 64
     # kernel injection (reference replace_with_kernel_inject): use the
     # Pallas decode kernel on the token-at-a-time path
     replace_with_kernel_inject: bool = True

@@ -40,10 +40,13 @@ class InferenceEngine:
                  params: Any = None, mesh: Optional[Mesh] = None):
         self.config = config or DeepSpeedInferenceConfig()
         self.dtype = self.config.compute_dtype()
-        if self.config.quant.enabled:
+        if self.config.quant.enabled and \
+                self.config.tensor_parallel.enabled and \
+                self.config.tensor_parallel.tp_size > 1:
             raise NotImplementedError(
-                "inference weight quantization is not implemented yet — "
-                "unset quant.enabled (a silently-ignored knob would be worse)")
+                "int8 weight-only serving with tensor parallelism is not "
+                "built (quant groups would need TP-aware slicing) — "
+                "drop tp_size to 1 or disable quant")
 
         # kernel injection: on a TransformerLM this toggles the Pallas
         # flash/decode attention path (the reference swaps in fused CUDA
@@ -98,6 +101,13 @@ class InferenceEngine:
                         self._cast, model.init(r)),
                     out_shardings=shardings)(jax.random.PRNGKey(0))
 
+        # -- int8 weight-only serving (reference GroupQuantizer at
+        # module_inject/replace_module.py:150: qkv/mlp weights stored int8,
+        # dequantized into the matmul) ---------------------------------
+        self._quantized = False
+        if self.config.quant.enabled:
+            self._quantize_weights()
+
         self._fwd = None
         self._gen_fns: Dict[Tuple, Any] = {}
         self._latencies: list = []
@@ -106,6 +116,75 @@ class InferenceEngine:
         if jnp.issubdtype(x.dtype, jnp.floating):
             return x.astype(self.dtype)
         return x
+
+    # ------------------------------------------------------------------
+    # int8 weight-only
+    # ------------------------------------------------------------------
+    def _quantize_weights(self) -> None:
+        """Matrix leaves → int8 + per-group fp32 scales, kept as parallel
+        trees; compiled programs dequantize on entry (XLA fuses the scale
+        multiply into the consumer). Weights at REST cost 1 byte/param;
+        note the transient cost: while a compiled program runs, the
+        dequantized compute-dtype copy is live too (~3 bytes/param peak
+        during generate) — per-layer dequant inside the model's scan would
+        bound that to one layer and is not built yet."""
+        from ..ops.quantizer.quantizer import quantize
+        bits = self.config.quant.bits or 8
+        tmpl = jax.device_get(jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), self.params))
+        self._qflags = jax.tree_util.tree_map(
+            lambda l: (len(l.shape) >= 2
+                       and jnp.issubdtype(l.dtype, jnp.floating)), tmpl)
+        self._qshapes = jax.tree_util.tree_map(lambda l: tuple(l.shape),
+                                               tmpl)
+
+        def g_of(leaf_shape):
+            # largest divisor of n at or under n/2048: group count must
+            # divide the element count (quantize reshapes to [G, -1])
+            n = int(np.prod(leaf_shape))
+            target = max(1, n // 2048)
+            for g in range(target, 0, -1):
+                if n % g == 0:
+                    return g
+            return 1
+
+        def qz(l, f):
+            if not f:
+                return l, jnp.zeros((0, 1), jnp.float32)
+            q, s, _ = quantize(l, bits, g_of(l.shape), True)
+            return q.astype(jnp.int8), s
+
+        with self.mesh:
+            pairs = jax.jit(lambda p: jax.tree_util.tree_map(
+                qz, p, self._qflags,
+                is_leaf=lambda x: isinstance(x, jax.Array)))(self.params)
+        tup = lambda t: isinstance(t, tuple)  # noqa: E731
+        self.params = jax.tree_util.tree_map(lambda t: t[0], pairs,
+                                             is_leaf=tup)
+        self._scales = jax.tree_util.tree_map(lambda t: t[1], pairs,
+                                              is_leaf=tup)
+        self._quantized = True
+        q_bytes = sum(l.nbytes for l in jax.tree_util.tree_leaves(
+            self.params))
+        logger.info(f"int8 weight-only serving: params now "
+                    f"{q_bytes / 2**20:.1f} MiB on device "
+                    f"(bits={bits})")
+
+    def _dequant(self, params, scales):
+        from ..ops.quantizer.quantizer import dequantize
+
+        def dq(q, s, f, sh):
+            if not f:
+                return q
+            return dequantize(q, s, None, sh, self.dtype)
+        return jax.tree_util.tree_map(dq, params, scales, self._qflags,
+                                      self._qshapes)
+
+    def _model_params(self, params, scales=None):
+        """What compiled programs call to get model-consumable params."""
+        if self._quantized:
+            return self._dequant(params, scales)
+        return params
 
     def _load_checkpoint(self, ckpt_dir: str, tag, shapes, shardings):
         """Restore the params subtree of a training checkpoint, resharded
@@ -138,8 +217,11 @@ class InferenceEngine:
         if self._fwd is None:
             with self.mesh:
                 self._fwd = jax.jit(
-                    lambda p, ids: self.module.apply(p, ids))
-        return self._fwd(self.params, jnp.asarray(input_ids))
+                    lambda p, s, ids: self.module.apply(
+                        self._model_params(p, s), ids))
+        return self._fwd(self.params,
+                         getattr(self, "_scales", None),
+                         jnp.asarray(input_ids))
 
     __call__ = forward
 
@@ -186,11 +268,20 @@ class InferenceEngine:
                 f"({self.config.max_batch_size}) — raise it in the config "
                 f"(it bounds the KV workspace, reference inference_context.h)")
 
-        def gen(params, ids, rng):
+        def gen(params, scales, ids, true_len, rng):
+            params = self._model_params(params, scales)
             cache = model.init_cache(batch, cache_len, dtype=self.dtype)
             logits, cache = model.apply(params, ids, cache=cache)  # prefill
+            # bucketing: ids are right-padded to the bucket; the padded
+            # positions' cache slots are dropped by resetting the index to
+            # the true length (decode overwrites them; the valid mask
+            # hides anything beyond), and the next-token logits come from
+            # the true last position
+            cache = {**cache, "index": true_len}
+            last = jax.lax.dynamic_slice_in_dim(
+                logits, true_len - 1, 1, axis=1)[:, 0]
             rng, sub = jax.random.split(rng)
-            tok = self._sample(logits[:, -1], sub, temperature, top_k, top_p)
+            tok = self._sample(last, sub, temperature, top_k, top_p)
             done = (jnp.zeros((batch,), jnp.bool_) if eos_token_id is None
                     else tok == eos_token_id)
 
@@ -224,12 +315,24 @@ class InferenceEngine:
                        else temperature)
         top_k = self.config.top_k if top_k is None else top_k
         top_p = self.config.top_p if top_p is None else top_p
+        true_len = ids.shape[1]
+        bucket = self.config.prompt_bucket
+        if bucket:
+            padded = max(bucket, -(-true_len // bucket) * bucket)
+            # never let padding spill the KV workspace the exact shape
+            # would have fit in
+            padded = min(padded,
+                         max(true_len,
+                             self.config.max_out_tokens - max_new_tokens))
+            if padded > true_len:
+                ids = jnp.pad(ids, ((0, 0), (0, padded - true_len)))
         key = (ids.shape[0], ids.shape[1], max_new_tokens, temperature,
                top_k, top_p, eos_token_id)
         if key not in self._gen_fns:
             self._gen_fns[key] = self._build_generate(*key)
         t0 = time.perf_counter()
-        out = self._gen_fns[key](self.params, ids,
+        out = self._gen_fns[key](self.params, getattr(self, "_scales", None),
+                                 ids, jnp.asarray(true_len, jnp.int32),
                                  rng if rng is not None
                                  else jax.random.PRNGKey(0))
         out.block_until_ready()

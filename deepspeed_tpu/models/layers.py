@@ -154,23 +154,58 @@ def apply_rotary(x, cos, sin, positions=None, interleaved=True):
 # ---------------------------------------------------------------------------
 def causal_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
                      scale: Optional[float] = None,
-                     kv_positions_offset: int = 0):
+                     kv_positions_offset: int = 0,
+                     causal: bool = True,
+                     bias: Optional[jnp.ndarray] = None):
     """q,k,v: [B, Tq, H, Dh] / [B, Tk, H, Dh]. Softmax in fp32 (the reference's
-    softmax_kernels.cu accumulates fp32 too). Returns [B, Tq, H, Dh]."""
+    softmax_kernels.cu accumulates fp32 too). Returns [B, Tq, H, Dh].
+
+    ``causal=False`` — encoder (bidirectional) attention. ``bias`` —
+    additive fp32 logit bias broadcastable to [B, H, Tq, Tk] (ALiBi)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     # bf16 operands, fp32 accumulation — MXU-native mixed precision.
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
     tq, tk = q.shape[1], k.shape[1]
-    q_pos = jnp.arange(tq) + kv_positions_offset
-    k_pos = jnp.arange(tk)
-    causal = q_pos[:, None] >= k_pos[None, :]
-    logits = jnp.where(causal[None, None], logits, -1e30)
+    if causal:
+        q_pos = jnp.arange(tq) + kv_positions_offset
+        k_pos = jnp.arange(tk)
+        cmask = q_pos[:, None] >= k_pos[None, :]
+        logits = jnp.where(cmask[None, None], logits, -1e30)
     if mask is not None:
         logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def alibi_slopes(num_heads: int) -> jnp.ndarray:
+    """ALiBi head slopes (Press et al.; BLOOM's build_alibi_tensor,
+    HF modeling_bloom.py): powers of 2^(-8/n) with the non-power-of-two
+    extension interleaving from 2^(-4/n)."""
+    import math as _m
+    n = 2 ** _m.floor(_m.log2(num_heads))
+    base = 2.0 ** (-(2.0 ** -(_m.log2(n) - 3)))
+    slopes = [base ** (i + 1) for i in range(n)]
+    if n < num_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(_m.log2(2 * n) - 3)))
+        extra = [extra_base ** (i + 1) for i in range(0, 2 * (num_heads - n),
+                                                      2)]
+        slopes += extra
+    return jnp.asarray(slopes, jnp.float32)
+
+
+def alibi_bias(num_heads: int, tk: int, q_positions) -> jnp.ndarray:
+    """[H, Tq, Tk] additive bias: -slope_h * |q_pos - k_pos| — equals the
+    BLOOM causal convention on the visible (k <= q) region and stays a
+    distance PENALTY (never a boost) for future keys when used
+    bidirectionally."""
+    slopes = alibi_slopes(num_heads)                     # [H]
+    k_pos = jnp.arange(tk)
+    rel = -jnp.abs(k_pos[None, :] - q_positions[:, None])   # [Tq, Tk] <= 0
+    return slopes[:, None, None] * rel[None].astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------

@@ -43,7 +43,21 @@ class TransformerConfig:
     d_model: int = 768
     d_ff: int = 0                      # 0 → 4 * d_model
     head_dim: int = 0                  # 0 → d_model // num_heads
-    pos_embedding: str = "learned"     # learned | rotary | none
+    pos_embedding: str = "learned"     # learned | rotary | alibi | none
+    # decoder (causal) vs encoder (bidirectional — the BERT family)
+    causal: bool = True
+    # pre-norm (GPT family: x + f(ln(x))) vs post-norm (BERT family:
+    # ln(x + f(x)))
+    norm_position: str = "pre"
+    # final norm after the block stack (BERT has none)
+    final_layernorm: bool = True
+    # BLOOM-style layernorm on the embedding output (params["ln_embed"])
+    embed_layernorm: bool = False
+    # BERT token-type (segment) embeddings; 0 = none
+    token_type_vocab: int = 0
+    # BERT MLM prediction head: dense+act+LN transform before the tied
+    # decoder, plus a decoder bias (params["mlm_head"])
+    mlm_head: bool = False
     rotary_pct: float = 1.0
     rotary_base: float = 10000.0
     # True = GPT-J "rotate_every_two" pairing (the pre-existing default —
@@ -270,14 +284,26 @@ class TransformerLM:
         keys = jax.random.split(rng, 8)
         params = {
             "embed": L.embedding_init(keys[0], c.vocab_size, d, 0.02, dt),
-            "ln_f": norm_init(None, d, dt),
         }
+        if c.final_layernorm:
+            params["ln_f"] = norm_init(None, d, dt)
         if c.pos_embedding == "learned":
             params["pos_embed"] = L.embedding_init(keys[2], c.max_seq_len, d,
                                                    0.01, dt)
         if not c.tie_embeddings:
             params["lm_head"] = {"kernel": L.normal_init(
                 keys[3], (d, c.vocab_size), 0.02, dt)}
+        if c.embed_layernorm:
+            params["ln_embed"] = norm_init(None, d, dt)
+        if c.token_type_vocab:
+            params["type_embed"] = L.embedding_init(
+                keys[4], c.token_type_vocab, d, 0.02, dt)
+        if c.mlm_head:
+            params["mlm_head"] = {
+                "dense": L.dense_init(keys[5], d, d, True, 0.02, dt),
+                "ln": norm_init(None, d, dt),
+                "bias": jnp.zeros((c.vocab_size,), dt),
+            }
         return params
 
     def init(self, rng) -> Dict:
@@ -305,6 +331,14 @@ class TransformerLM:
                 f"materializes the [B,H,T,T] score matrix. Pad the sequence "
                 f"to a multiple of the flash block for the fast path.")
             TransformerLM._flash_fallback_warned = True
+
+    def _norm_fn(self):
+        """The configured norm apply with eps bound (single source for the
+        six former copies of the layernorm/rmsnorm selector)."""
+        c = self.config
+        base = (L.layernorm_apply if c.norm_type == "layernorm"
+                else L.rmsnorm_apply)
+        return partial(base, eps=c.layernorm_eps)
 
     def _maybe_qact(self, x):
         """Activation-quantization seam (compression subsystem): STE
@@ -353,11 +387,12 @@ class TransformerLM:
             o = blocksparse_attention_bthd(q, k, v, c.sparsity_config)
             o = o.reshape(b, t, nh * hd)
             return L.dense_apply(p["out"], o), None
-        if cache_kv is None and c.attn_impl == "flash":
+        if cache_kv is None and c.attn_impl == "flash" and \
+                c.pos_embedding != "alibi":
             from ..ops.transformer.flash_attention import (
                 flash_attention_bthd, supports)
             if supports(q.shape[1], k.shape[1]):
-                o = flash_attention_bthd(q, k, v)
+                o = flash_attention_bthd(q, k, v, causal=c.causal)
                 o = o.reshape(b, t, nh * hd)
                 return L.dense_apply(p["out"], o), None
             self._warn_flash_fallback(q.shape[1], k.shape[1])
@@ -381,7 +416,12 @@ class TransformerLM:
             offset = idx
             new_cache = (ck, cv)
             tk = ck.shape[1]
-            if t == 1 and c.attn_impl == "flash":
+            if not c.causal:
+                raise NotImplementedError(
+                    "KV-cache decode on a non-causal (encoder) model is "
+                    "meaningless — encoders have no autoregressive order")
+            if t == 1 and c.attn_impl == "flash" and \
+                    c.pos_embedding != "alibi":
                 # token-at-a-time hot path → fused Pallas decode kernel
                 # (reference softmax_context, csrc/.../softmax.cu)
                 from ..ops.transformer import decode_attention as DA
@@ -392,10 +432,19 @@ class TransformerLM:
                     o = o.reshape(b, t, nh * hd)
                     return L.dense_apply(p["out"], o), new_cache
             valid = jnp.arange(tk)[None, None, None, :] < (idx + t)
+            bias = None
+            if c.pos_embedding == "alibi":
+                qpos = (positions[0] if positions is not None
+                        else idx + jnp.arange(t))
+                bias = L.alibi_bias(nh, tk, qpos)[None]
             o = L.causal_attention(q, k.astype(q.dtype), v.astype(q.dtype),
-                                   mask=valid, kv_positions_offset=offset)
+                                   mask=valid, kv_positions_offset=offset,
+                                   bias=bias)
         else:
-            o = L.causal_attention(q, k, v)
+            bias = None
+            if c.pos_embedding == "alibi":
+                bias = L.alibi_bias(nh, t, jnp.arange(t))[None]
+            o = L.causal_attention(q, k, v, causal=c.causal, bias=bias)
         o = o.reshape(b, t, nh * hd)
         return L.dense_apply(p["out"], o), new_cache
 
@@ -406,11 +455,15 @@ class TransformerLM:
 
     def _block(self, bp, x, cache_kv=None, positions=None):
         c = self.config
-        norm = (L.layernorm_apply if c.norm_type == "layernorm"
-                else L.rmsnorm_apply)
-        norm = partial(norm, eps=c.layernorm_eps)
+        norm = self._norm_fn()
         x = self.constrain(x)
-        if c.parallel_residual:
+        if c.norm_position == "post":
+            # BERT family: ln(x + f(x)); ln1 after attention, ln2 after FFN
+            a, new_cache = self._attention(bp["attn"], x, cache_kv,
+                                           positions)
+            x = norm(bp["ln1"], x + a)
+            x = norm(bp["ln2"], x + self._mlp(bp["mlp"], x))
+        elif c.parallel_residual:
             a, new_cache = self._attention(bp["attn"], norm(bp["ln1"], x),
                                            cache_kv, positions)
             m = self._mlp(bp["mlp"], norm(bp["ln2"], x))
@@ -426,9 +479,7 @@ class TransformerLM:
                    train=True):
         """Attention + MoE-FFN block. Returns (x, new_cache, l_aux)."""
         c = self.config
-        norm = (L.layernorm_apply if c.norm_type == "layernorm"
-                else L.rmsnorm_apply)
-        norm = partial(norm, eps=c.layernorm_eps)
+        norm = self._norm_fn()
         x = self.constrain(x)
         a, new_cache = self._attention(bp["attn"], norm(bp["ln1"], x),
                                        cache_kv, positions)
@@ -486,7 +537,8 @@ class TransformerLM:
         return jax.checkpoint(fn, policy=policy)
 
     # -- full forward ------------------------------------------------------
-    def apply(self, params, input_ids, cache=None, positions=None):
+    def apply(self, params, input_ids, cache=None, positions=None,
+              token_type_ids=None):
         """input_ids [B, T] → logits [B, T, V] (fp32).
 
         ``cache`` — KV cache dict from `init_cache` for incremental decoding;
@@ -496,16 +548,16 @@ class TransformerLM:
         if cache is None:
             # inference semantics: eval capacity factor, no gate noise —
             # same gating mode as the cached decode branch below
-            x, _ = self.hidden_states_and_aux(params, input_ids, train=False)
+            x, _ = self.hidden_states_and_aux(
+                params, input_ids, train=False,
+                token_type_ids=token_type_ids)
             return self._project(params, x)
 
         idx = cache["index"]
         if positions is None:
             # incremental decode default: continue from the cache index
             positions = idx + jnp.arange(input_ids.shape[1])[None, :]
-        x = L.embedding_apply(params["embed"], input_ids, c.dtype)
-        if c.pos_embedding == "learned":
-            x = x + L.embedding_apply(params["pos_embed"], positions, c.dtype)
+        x = self._embed_tokens(params, input_ids, positions=positions)
 
         if c.moe_enabled:
             # cache leaves: [scan, A, B, T, H, Dh], A = attns per superblock
@@ -526,25 +578,52 @@ class TransformerLM:
         x, (nk, nv) = jax.lax.scan(scan_fn, x,
                                    (params["blocks"], cache["k"], cache["v"]))
         new_cache = {"k": nk, "v": nv, "index": idx + input_ids.shape[1]}
-        norm = (L.layernorm_apply if c.norm_type == "layernorm"
-                else L.rmsnorm_apply)
-        x = norm(params["ln_f"], x, eps=c.layernorm_eps)
+        if c.final_layernorm:
+            x = self._norm_fn()(params["ln_f"], x)
         return self._project(params, x), new_cache
 
+    def _embed_tokens(self, params, input_ids, positions=None,
+                      token_type_ids=None):
+        """Shared embedding path: word (+ position, + token-type) embeds,
+        then the optional embedding layernorm (BLOOM, BERT)."""
+        c = self.config
+        x = L.embedding_apply(params["embed"], input_ids, c.dtype)
+        if c.pos_embedding == "learned":
+            if positions is None:
+                positions = jnp.arange(input_ids.shape[1])[None, :]
+            x = x + L.embedding_apply(params["pos_embed"], positions,
+                                      c.dtype)
+        if c.token_type_vocab:
+            tt = (token_type_ids if token_type_ids is not None
+                  else jnp.zeros_like(input_ids))
+            x = x + L.embedding_apply(params["type_embed"], tt, c.dtype)
+        if c.embed_layernorm:
+            x = self._norm_fn()(params["ln_embed"], x)
+        return x
+
     def _project(self, params, x):
-        if self.config.tie_embeddings:
+        c = self.config
+        if c.mlm_head:
+            # BERT prediction-head transform (HF BertLMPredictionHead):
+            # dense → act → LN → tied decoder + vocab bias
+            mh = params["mlm_head"]
+            h = L.dense_apply(mh["dense"], x)
+            h = L.ACT_FNS[c.activation](h)
+            h = self._norm_fn()(mh["ln"], h)
+            logits = L.embedding_attend(params["embed"], h)
+            return logits + mh["bias"].astype(logits.dtype)
+        if c.tie_embeddings:
             return L.embedding_attend(params["embed"], x)
         return jnp.einsum("...d,dv->...v", x,
                           params["lm_head"]["kernel"].astype(x.dtype),
                           preferred_element_type=jnp.float32)
 
-    def hidden_states_and_aux(self, params, input_ids, rng=None, train=True):
+    def hidden_states_and_aux(self, params, input_ids, rng=None, train=True,
+                              token_type_ids=None):
         """Forward up to the final norm → ([B,T,D], moe_aux_loss scalar)."""
         c = self.config
-        x = L.embedding_apply(params["embed"], input_ids, c.dtype)
-        if c.pos_embedding == "learned":
-            pos = jnp.arange(input_ids.shape[1])[None, :]
-            x = x + L.embedding_apply(params["pos_embed"], pos, c.dtype)
+        x = self._embed_tokens(params, input_ids,
+                               token_type_ids=token_type_ids)
 
         def sb_fn(sp, x, key):
             y, _, la = self._superblock(sp, x, None, None, key, train)
@@ -566,9 +645,9 @@ class TransformerLM:
                 y, la = sb(sp, carry[0], None)
                 return (y, carry[1] + la), None
             (x, laux), _ = jax.lax.scan(scan_fn, (x, zero), params["blocks"])
-        norm = (L.layernorm_apply if c.norm_type == "layernorm"
-                else L.rmsnorm_apply)
-        return norm(params["ln_f"], x, eps=c.layernorm_eps), laux
+        if not c.final_layernorm:
+            return x, laux
+        return self._norm_fn()(params["ln_f"], x), laux
 
     def hidden_states(self, params, input_ids):
         """Forward up to the final norm, pre-projection ([B,T,D])."""
@@ -677,6 +756,10 @@ class TransformerLM:
         ("fc_out", "kernel"): ("model", None),
         ("fc_out", "bias"): (None,),
         ("lm_head", "kernel"): (None, "model"),
+        ("type_embed", "embedding"): (None, None),
+        ("dense", "kernel"): (None, None),     # mlm_head transform
+        ("dense", "bias"): (None,),
+        ("mlm_head", "bias"): (None,),
     }
 
     def partition_specs(self, params=None) -> Dict:

@@ -172,10 +172,268 @@ def load_hf_neox(state_dict: Dict[str, Any],
     return params
 
 
+def hf_opt_config(hf_cfg, **overrides) -> TransformerConfig:
+    """transformers.OPTConfig → TransformerConfig (reference
+    `containers/opt.py` / HFOPTLayerPolicy)."""
+    if not getattr(hf_cfg, "do_layer_norm_before", True):
+        raise ValueError("OPT with do_layer_norm_before=False (350m "
+                         "post-norm variant) is not supported")
+    return TransformerConfig(
+        vocab_size=hf_cfg.vocab_size,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        d_model=hf_cfg.hidden_size,
+        d_ff=hf_cfg.ffn_dim,
+        pos_embedding="learned",
+        parallel_residual=False,
+        norm_type="layernorm",
+        activation=_map_act(hf_cfg.activation_function),
+        use_bias=True,
+        tie_embeddings=True,
+        layernorm_eps=1e-5,
+        **overrides)
+
+
+def load_hf_opt(state_dict: Dict[str, Any],
+                config: TransformerConfig) -> Dict:
+    """HF OPT state dict → params. Torch Linear kernels transpose; the
+    separate q/k/v projections concatenate into our fused [d, 3·nh·hd]
+    layout (q|k|v blocks, head-major — the order our qkv reshape reads);
+    OPT's positional table carries a +2 offset (HF
+    OPTLearnedPositionalEmbedding) — rows 2: are the real positions."""
+    sd = {k.replace("model.decoder.", ""): v
+          for k, v in state_dict.items()}
+    n = config.num_layers
+
+    def t(name, i):
+        return _np(sd[f"layers.{i}.{name}.weight"]).T
+
+    def b(name, i):
+        return _np(sd[f"layers.{i}.{name}.bias"])
+
+    qkv_w = np.stack([np.concatenate(
+        [t("self_attn.q_proj", i), t("self_attn.k_proj", i),
+         t("self_attn.v_proj", i)], axis=-1) for i in range(n)])
+    qkv_b = np.stack([np.concatenate(
+        [b("self_attn.q_proj", i), b("self_attn.k_proj", i),
+         b("self_attn.v_proj", i)]) for i in range(n)])
+
+    def blk_t(name):
+        return np.stack([t(name, i) for i in range(n)])
+
+    def blk_b(name):
+        return np.stack([b(name, i) for i in range(n)])
+
+    def blk_ln(name, leaf):
+        return _stack(sd, "layers.{i}." + name + "." + leaf, n)
+
+    params = {
+        "embed": {"embedding": _np(sd["embed_tokens.weight"])},
+        "pos_embed": {"embedding": _np(sd["embed_positions.weight"])[2:]},
+        "blocks": {
+            "ln1": {"scale": blk_ln("self_attn_layer_norm", "weight"),
+                    "bias": blk_ln("self_attn_layer_norm", "bias")},
+            "attn": {
+                "qkv": {"kernel": qkv_w, "bias": qkv_b},
+                "out": {"kernel": blk_t("self_attn.out_proj"),
+                        "bias": blk_b("self_attn.out_proj")},
+            },
+            "ln2": {"scale": blk_ln("final_layer_norm", "weight"),
+                    "bias": blk_ln("final_layer_norm", "bias")},
+            "mlp": {
+                "fc_in": {"kernel": blk_t("fc1"), "bias": blk_b("fc1")},
+                "fc_out": {"kernel": blk_t("fc2"), "bias": blk_b("fc2")},
+            },
+        },
+        "ln_f": {"scale": _np(sd["final_layer_norm.weight"]),
+                 "bias": _np(sd["final_layer_norm.bias"])},
+    }
+    return params
+
+
+def hf_bloom_config(hf_cfg, **overrides) -> TransformerConfig:
+    """transformers.BloomConfig → TransformerConfig (reference
+    `containers/bloom.py` / BLOOMLayerPolicy): ALiBi positions + embedding
+    layernorm."""
+    return TransformerConfig(
+        vocab_size=hf_cfg.vocab_size,
+        max_seq_len=2048,
+        num_layers=hf_cfg.n_layer,
+        num_heads=hf_cfg.n_head,
+        d_model=hf_cfg.hidden_size,
+        pos_embedding="alibi",
+        embed_layernorm=True,
+        parallel_residual=False,
+        norm_type="layernorm",
+        activation="gelu",            # BloomGelu is the tanh approximation
+        use_bias=True,
+        tie_embeddings=True,
+        layernorm_eps=hf_cfg.layer_norm_epsilon,
+        **overrides)
+
+
+def load_hf_bloom(state_dict: Dict[str, Any],
+                  config: TransformerConfig) -> Dict:
+    """HF BLOOM state dict → params. Fused QKV is per-head [h, 3, hd] on
+    the output dim (HF modeling_bloom _split_heads) → regrouped to our
+    [3, h, hd]; torch Linear kernels transpose."""
+    sd = {k.replace("transformer.", ""): v for k, v in state_dict.items()}
+    n, nh = config.num_layers, config.num_heads
+    d, hd = config.d_model, config.hdim
+
+    qkv_w = np.stack([_np(sd[f"h.{i}.self_attention.query_key_value.weight"])
+                      for i in range(n)])              # [L, 3D, D] torch
+    qkv_w = (qkv_w.reshape(n, nh, 3, hd, d)
+             .transpose(0, 4, 2, 1, 3)                 # [L, D, 3, h, hd]
+             .reshape(n, d, 3 * nh * hd))
+    qkv_b = np.stack([_np(sd[f"h.{i}.self_attention.query_key_value.bias"])
+                      for i in range(n)])
+    qkv_b = (qkv_b.reshape(n, nh, 3, hd).transpose(0, 2, 1, 3)
+             .reshape(n, 3 * nh * hd))
+
+    def blk_t(name):
+        return np.stack([
+            _np(sd[f"h.{i}.{name}.weight"]).T for i in range(n)])
+
+    def blk_b(name):
+        return _stack(sd, "h.{i}." + name + ".bias", n)
+
+    def blk_ln(name, leaf):
+        return _stack(sd, "h.{i}." + name + "." + leaf, n)
+
+    params = {
+        "embed": {"embedding": _np(sd["word_embeddings.weight"])},
+        "ln_embed": {"scale": _np(sd["word_embeddings_layernorm.weight"]),
+                     "bias": _np(sd["word_embeddings_layernorm.bias"])},
+        "blocks": {
+            "ln1": {"scale": blk_ln("input_layernorm", "weight"),
+                    "bias": blk_ln("input_layernorm", "bias")},
+            "attn": {
+                "qkv": {"kernel": qkv_w, "bias": qkv_b},
+                "out": {"kernel": blk_t("self_attention.dense"),
+                        "bias": blk_b("self_attention.dense")},
+            },
+            "ln2": {"scale": blk_ln("post_attention_layernorm", "weight"),
+                    "bias": blk_ln("post_attention_layernorm", "bias")},
+            "mlp": {
+                "fc_in": {"kernel": blk_t("mlp.dense_h_to_4h"),
+                          "bias": blk_b("mlp.dense_h_to_4h")},
+                "fc_out": {"kernel": blk_t("mlp.dense_4h_to_h"),
+                           "bias": blk_b("mlp.dense_4h_to_h")},
+            },
+        },
+        "ln_f": {"scale": _np(sd["ln_f.weight"]),
+                 "bias": _np(sd["ln_f.bias"])},
+    }
+    return params
+
+
+def hf_bert_config(hf_cfg, **overrides) -> TransformerConfig:
+    """transformers.BertConfig → TransformerConfig (reference
+    `containers/bert.py` / HFBertLayerPolicy): bidirectional post-norm
+    encoder with token types and the MLM prediction head."""
+    return TransformerConfig(
+        vocab_size=hf_cfg.vocab_size,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        d_model=hf_cfg.hidden_size,
+        d_ff=hf_cfg.intermediate_size,
+        pos_embedding="learned",
+        causal=False,
+        norm_position="post",
+        final_layernorm=False,
+        embed_layernorm=True,
+        token_type_vocab=hf_cfg.type_vocab_size,
+        mlm_head=True,
+        norm_type="layernorm",
+        activation=_map_act(hf_cfg.hidden_act),
+        use_bias=True,
+        tie_embeddings=True,
+        layernorm_eps=hf_cfg.layer_norm_eps,
+        **overrides)
+
+
+def load_hf_bert(state_dict: Dict[str, Any],
+                 config: TransformerConfig) -> Dict:
+    """HF BertForMaskedLM state dict → params. Separate q/k/v transpose +
+    concat to the fused layout; post-norm LNs map attention.output.
+    LayerNorm → ln1 and output.LayerNorm → ln2."""
+    sd = {k.replace("bert.", ""): v for k, v in state_dict.items()}
+    n = config.num_layers
+    pre = "encoder.layer.{i}."
+
+    def t(name, i):
+        return _np(sd[f"encoder.layer.{i}.{name}.weight"]).T
+
+    def b(name, i):
+        return _np(sd[f"encoder.layer.{i}.{name}.bias"])
+
+    qkv_w = np.stack([np.concatenate(
+        [t("attention.self.query", i), t("attention.self.key", i),
+         t("attention.self.value", i)], axis=-1) for i in range(n)])
+    qkv_b = np.stack([np.concatenate(
+        [b("attention.self.query", i), b("attention.self.key", i),
+         b("attention.self.value", i)]) for i in range(n)])
+
+    def blk_t(name):
+        return np.stack([t(name, i) for i in range(n)])
+
+    def blk_b(name):
+        return np.stack([b(name, i) for i in range(n)])
+
+    def blk_ln(name, leaf):
+        return _stack(sd, pre + name + "." + leaf, n)
+
+    params = {
+        "embed": {"embedding": _np(sd["embeddings.word_embeddings.weight"])},
+        "pos_embed": {"embedding": _np(
+            sd["embeddings.position_embeddings.weight"])},
+        "type_embed": {"embedding": _np(
+            sd["embeddings.token_type_embeddings.weight"])},
+        "ln_embed": {"scale": _np(sd["embeddings.LayerNorm.weight"]),
+                     "bias": _np(sd["embeddings.LayerNorm.bias"])},
+        "blocks": {
+            "ln1": {"scale": blk_ln("attention.output.LayerNorm", "weight"),
+                    "bias": blk_ln("attention.output.LayerNorm", "bias")},
+            "attn": {
+                "qkv": {"kernel": qkv_w, "bias": qkv_b},
+                "out": {"kernel": blk_t("attention.output.dense"),
+                        "bias": blk_b("attention.output.dense")},
+            },
+            "ln2": {"scale": blk_ln("output.LayerNorm", "weight"),
+                    "bias": blk_ln("output.LayerNorm", "bias")},
+            "mlp": {
+                "fc_in": {"kernel": blk_t("intermediate.dense"),
+                          "bias": blk_b("intermediate.dense")},
+                "fc_out": {"kernel": blk_t("output.dense"),
+                           "bias": blk_b("output.dense")},
+            },
+        },
+        "mlm_head": {
+            "dense": {
+                "kernel": _np(state_dict[
+                    "cls.predictions.transform.dense.weight"]).T,
+                "bias": _np(state_dict[
+                    "cls.predictions.transform.dense.bias"])},
+            "ln": {"scale": _np(state_dict[
+                       "cls.predictions.transform.LayerNorm.weight"]),
+                   "bias": _np(state_dict[
+                       "cls.predictions.transform.LayerNorm.bias"])},
+            "bias": _np(state_dict["cls.predictions.bias"]),
+        },
+    }
+    return params
+
+
 # registry (reference replace_policy.py:17)
 POLICIES = {
     "gpt2": (hf_gpt2_config, load_hf_gpt2),
     "gpt_neox": (hf_neox_config, load_hf_neox),
+    "opt": (hf_opt_config, load_hf_opt),
+    "bloom": (hf_bloom_config, load_hf_bloom),
+    "bert": (hf_bert_config, load_hf_bert),
 }
 
 

@@ -1,12 +1,15 @@
 """Data efficiency — counterpart of
 `/root/reference/deepspeed/runtime/data_pipeline/`."""
 from .curriculum_scheduler import CurriculumScheduler
+from .data_sampler import (DataAnalyzer, DeepSpeedDataSampler,
+                           curriculum_batches)
 from .indexed_dataset import (IndexedDatasetBuilder, MMapIndexedDataset,
                               write_dataset)
 from .random_ltd import (RandomLTDConfig, gather_tokens, kept_tokens_at,
                          random_ltd_layer, sample_indices, scatter_tokens)
 
-__all__ = ["CurriculumScheduler", "IndexedDatasetBuilder",
+__all__ = ["CurriculumScheduler", "DataAnalyzer", "DeepSpeedDataSampler",
+           "curriculum_batches", "IndexedDatasetBuilder",
            "MMapIndexedDataset", "write_dataset", "RandomLTDConfig",
            "kept_tokens_at", "sample_indices", "gather_tokens",
            "scatter_tokens", "random_ltd_layer"]

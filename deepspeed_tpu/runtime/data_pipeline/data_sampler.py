@@ -1,0 +1,151 @@
+"""Difficulty-indexed data analysis + curriculum SAMPLING.
+
+Role-equivalent of the reference data-efficiency pair
+(`/root/reference/deepspeed/runtime/data_pipeline/data_sampling/
+data_analyzer.py:18` DataAnalyzer — an offline pass computing per-sample
+difficulty metrics and writing index maps — and `data_sampler.py:33`
+DeepSpeedDataSampler — a sampler that, each step, draws the batch only
+from samples whose difficulty is within the curriculum's current bound,
+deterministically and sharded across data-parallel ranks).
+
+The round-2 curriculum here only TRUNCATED batches (sequence-length
+curriculum); this module adds the reference's stronger capability: the
+curriculum *selects data*. Redesign notes:
+
+  - The analyzer stores, per metric: a ``<name>_values.npy`` (metric per
+    sample) and ``<name>_order.npy`` (sample ids sorted by metric) — the
+    reference's index-to-sample map collapses to a prefix of the sorted
+    order, found by binary search on the sorted values.
+  - Sampling is a pure function of (seed, step): every rank computes the
+    same global batch and takes its contiguous slice — no broadcast, same
+    determinism contract as the reference's deterministic shuffle.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Iterator, Optional, Sequence
+
+import numpy as np
+
+from ...utils.logging import logger
+
+
+class DataAnalyzer:
+    """Offline metric pass (reference data_analyzer.py:18).
+
+    ``metric_functions``: name → fn(sample) -> scalar difficulty. Built-in
+    name "seqlen" needs no function (uses len(sample))."""
+
+    def __init__(self, dataset, save_path: str,
+                 metric_functions: Optional[Dict[str, Callable]] = None):
+        self.dataset = dataset
+        self.save_path = save_path
+        self.metric_functions = dict(metric_functions or {})
+        if not self.metric_functions:
+            self.metric_functions = {"seqlen": len}
+
+    def run(self) -> Dict[str, str]:
+        """Compute every metric over the dataset; write value + order
+        files. Returns {metric: path_prefix}."""
+        os.makedirs(self.save_path, exist_ok=True)
+        n = len(self.dataset)
+        out = {}
+        for name, fn in self.metric_functions.items():
+            values = np.empty(n, np.float64)
+            for i in range(n):
+                values[i] = float(fn(self.dataset[i]))
+            order = np.argsort(values, kind="stable").astype(np.int64)
+            vpath = os.path.join(self.save_path, f"{name}_values.npy")
+            opath = os.path.join(self.save_path, f"{name}_order.npy")
+            np.save(vpath, values)
+            np.save(opath, order)
+            out[name] = os.path.join(self.save_path, name)
+            logger.info(f"DataAnalyzer: metric '{name}' over {n} samples "
+                        f"-> [{values.min():.3g}, {values.max():.3g}]")
+        return out
+
+    @staticmethod
+    def load(save_path: str, metric: str):
+        values = np.load(os.path.join(save_path, f"{metric}_values.npy"))
+        order = np.load(os.path.join(save_path, f"{metric}_order.npy"))
+        return values, order
+
+
+class DeepSpeedDataSampler:
+    """Curriculum-bounded deterministic sampler (reference
+    data_sampler.py:33).
+
+    Each ``sample_batch(step)`` draws ``global_batch_size`` sample ids
+    uniformly from the pool {i : metric[i] <= difficulty(step)} (value
+    mode) or the easiest ``difficulty(step)`` PERCENT of samples
+    (percentile mode), then returns this rank's contiguous shard. The draw
+    is a pure function of (seed, step) — identical on every rank, across
+    restarts, and after checkpoint resume."""
+
+    def __init__(self, values: np.ndarray, order: np.ndarray,
+                 curriculum: "CurriculumScheduler",
+                 global_batch_size: int,
+                 difficulty_type: str = "value",
+                 dp_rank: int = 0, dp_world: int = 1, seed: int = 1234):
+        from .curriculum_scheduler import CurriculumScheduler  # noqa: F401
+        if difficulty_type not in ("value", "percentile"):
+            raise ValueError(
+                f"difficulty_type must be value|percentile, got "
+                f"{difficulty_type}")
+        if global_batch_size % dp_world:
+            raise ValueError(f"global batch {global_batch_size} must "
+                             f"divide by dp_world {dp_world}")
+        self.values = np.asarray(values)
+        self.order = np.asarray(order)
+        self.sorted_values = self.values[self.order]
+        self.curriculum = curriculum
+        self.global_batch_size = int(global_batch_size)
+        self.difficulty_type = difficulty_type
+        self.dp_rank, self.dp_world = int(dp_rank), int(dp_world)
+        self.seed = int(seed)
+
+    def pool_size(self, step: int) -> int:
+        d = self.curriculum.get_difficulty(step)
+        n = len(self.order)
+        if self.difficulty_type == "percentile":
+            k = int(np.ceil(n * min(max(d, 0), 100) / 100.0))
+        else:
+            k = int(np.searchsorted(self.sorted_values, d, side="right"))
+        return max(k, 1)   # never an empty pool: easiest sample qualifies
+
+    def sample_batch(self, step: int) -> np.ndarray:
+        """Global-batch sample ids for ``step``, this rank's shard."""
+        k = self.pool_size(step)
+        rng = np.random.default_rng((self.seed, step))
+        pool = self.order[:k]
+        replace = k < self.global_batch_size
+        picks = rng.choice(k, size=self.global_batch_size, replace=replace)
+        batch = pool[picks]
+        per = self.global_batch_size // self.dp_world
+        return batch[self.dp_rank * per:(self.dp_rank + 1) * per]
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        step = 0
+        while True:
+            yield self.sample_batch(step)
+            step += 1
+
+
+def curriculum_batches(dataset, sampler: DeepSpeedDataSampler,
+                       collate: Optional[Callable] = None
+                       ) -> Iterator:
+    """Convenience: sample ids → actual batches from the dataset.
+    ``collate`` defaults to right-padding token sequences with 0 into
+    [B, max_len] int32 (the indexed-dataset document shape)."""
+    def default_collate(samples):
+        mx = max(len(s) for s in samples)
+        out = np.zeros((len(samples), mx), np.int32)
+        mask = np.zeros((len(samples), mx), np.float32)
+        for i, s in enumerate(samples):
+            out[i, :len(s)] = s
+            mask[i, :len(s)] = 1.0
+        return {"input_ids": out, "loss_mask": mask}
+
+    collate = collate or default_collate
+    for ids in sampler:
+        yield collate([dataset[int(i)] for i in ids])

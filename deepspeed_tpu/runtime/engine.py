@@ -617,6 +617,11 @@ class DeepSpeedEngine:
         device arrays sharded over the data axes."""
         gas = self.gradient_accumulation_steps
         global_b = self.train_batch_size
+        # multi-host: each process supplies its LOCAL slice of the global
+        # batch (launcher/dataloader contract, reference deepspeed.runtime
+        # dataloader sharding)
+        nproc = jax.process_count()
+        local_b = global_b // nproc if nproc > 1 else global_b
 
         def prep(k, x):
             x = np.asarray(x)
@@ -631,15 +636,29 @@ class DeepSpeedEngine:
                         f"moe_rng must be a PRNG key (2,) or per-microbatch "
                         f"keys ({gas}, 2); got {x.shape}")
                 return x.astype(np.uint32)
-            if x.ndim >= 1 and x.shape[0] == global_b:
-                return x.reshape((gas, global_b // gas) + x.shape[1:])
+            if x.ndim >= 1 and x.shape[0] == local_b:
+                return x.reshape((gas, local_b // gas) + x.shape[1:])
             if x.ndim >= 2 and x.shape[0] == gas:
-                return x  # already [gas, micro*dp, ...]
+                return x  # already [gas, micro*dp(_local), ...]
             raise ValueError(
-                f"batch leading dim {x.shape[0]} matches neither "
-                f"train_batch_size ({global_b}) nor [gas={gas}, ...] layout")
+                f"batch leading dim {x.shape[0]} matches neither the "
+                f"process-local batch ({local_b}"
+                f"{f' = {global_b}/{nproc} procs' if nproc > 1 else ''}) "
+                f"nor [gas={gas}, ...] layout")
         batch = {k: prep(k, v) for k, v in batch.items()}
         shardings = to_named(self.mesh, self._batch_spec_tree(batch))
+        if nproc > 1:
+            # assemble global arrays from per-process shards — device_put
+            # cannot write non-addressable shards
+            def to_global(x, sharding):
+                x = np.asarray(x)
+                spec = sharding.spec
+                gshape = list(x.shape)
+                if len(spec) > 1 and spec[1] is not None:
+                    gshape[1] = gshape[1] * nproc
+                return jax.make_array_from_process_local_data(
+                    sharding, x, tuple(gshape))
+            return jax.tree_util.tree_map(to_global, batch, shardings)
         return jax.device_put(batch, shardings)
 
     def train_step(self, batch: Dict) -> Dict:

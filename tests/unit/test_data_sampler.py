@@ -1,0 +1,119 @@
+"""Difficulty-bucketed curriculum sampling (VERDICT r2 #6).
+
+Reference coverage model: `/root/reference/tests/unit/test_data_efficiency.py`
+(curriculum scheduling + sampler determinism).
+"""
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumScheduler,
+                                                 DataAnalyzer,
+                                                 DeepSpeedDataSampler,
+                                                 MMapIndexedDataset,
+                                                 curriculum_batches,
+                                                 write_dataset)
+
+
+def make_dataset(tmp_path, n=64):
+    """Documents with lengths 4..4+n-1 (difficulty == seqlen)."""
+    rng = np.random.default_rng(0)
+    docs = [rng.integers(1, 100, 4 + i).tolist() for i in range(n)]
+    prefix = str(tmp_path / "ds")
+    write_dataset(prefix, docs)
+    return MMapIndexedDataset(prefix), docs
+
+
+def make_sampler(tmp_path, n=64, total_steps=10, gbs=8, **kw):
+    ds, docs = make_dataset(tmp_path, n)
+    analyzer = DataAnalyzer(ds, str(tmp_path / "metrics"))
+    analyzer.run()
+    values, order = DataAnalyzer.load(str(tmp_path / "metrics"), "seqlen")
+    cur = CurriculumScheduler({
+        "min_difficulty": 8, "max_difficulty": 4 + n - 1,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": total_steps,
+                            "difficulty_step": 1}})
+    return DeepSpeedDataSampler(values, order, cur, gbs, **kw), ds, docs
+
+
+class TestAnalyzer:
+    def test_metric_files_roundtrip(self, tmp_path):
+        ds, docs = make_dataset(tmp_path)
+        DataAnalyzer(ds, str(tmp_path / "m")).run()
+        values, order = DataAnalyzer.load(str(tmp_path / "m"), "seqlen")
+        assert len(values) == len(docs)
+        assert (np.diff(values[order]) >= 0).all()     # sorted order
+        np.testing.assert_array_equal(
+            values, [len(d) for d in docs])
+
+    def test_custom_metric(self, tmp_path):
+        ds, docs = make_dataset(tmp_path)
+        DataAnalyzer(ds, str(tmp_path / "m"),
+                     {"vocab_max": lambda s: int(np.max(s))}).run()
+        values, _ = DataAnalyzer.load(str(tmp_path / "m"), "vocab_max")
+        assert values[0] == max(docs[0])
+
+
+class TestSampler:
+    def test_curriculum_changes_batch_composition(self, tmp_path):
+        """The VERDICT 'done' criterion: difficulty bound deterministically
+        changes WHICH samples appear."""
+        sampler, ds, docs = make_sampler(tmp_path)
+        early = sampler.sample_batch(0)
+        late = sampler.sample_batch(10)
+        early_lens = [len(docs[i]) for i in early]
+        late_lens = [len(docs[i]) for i in late]
+        assert max(early_lens) <= 8                  # min_difficulty bound
+        assert max(late_lens) > 16                   # pool opened up
+        # pool grows monotonically with the schedule
+        assert sampler.pool_size(0) < sampler.pool_size(5) \
+            < sampler.pool_size(10)
+
+    def test_deterministic_across_instances(self, tmp_path):
+        s1, _, _ = make_sampler(tmp_path)
+        s2, _, _ = make_sampler(tmp_path)
+        for step in (0, 3, 7, 10):
+            np.testing.assert_array_equal(s1.sample_batch(step),
+                                          s2.sample_batch(step))
+
+    def test_dp_shards_partition_global_batch(self, tmp_path):
+        full, _, _ = make_sampler(tmp_path, gbs=8)
+        shards = []
+        for r in range(4):
+            s, _, _ = make_sampler(tmp_path, gbs=8, dp_rank=r, dp_world=4)
+            shards.append(s.sample_batch(5))
+        np.testing.assert_array_equal(np.concatenate(shards),
+                                      full.sample_batch(5))
+        assert all(len(s) == 2 for s in shards)
+
+    def test_percentile_mode(self, tmp_path):
+        ds, docs = make_dataset(tmp_path)
+        DataAnalyzer(ds, str(tmp_path / "m")).run()
+        values, order = DataAnalyzer.load(str(tmp_path / "m"), "seqlen")
+        cur = CurriculumScheduler({
+            "min_difficulty": 25, "max_difficulty": 100,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4,
+                                "difficulty_step": 1}})
+        s = DeepSpeedDataSampler(values, order, cur, 8,
+                                 difficulty_type="percentile")
+        assert s.pool_size(0) == 16                  # easiest 25% of 64
+        assert s.pool_size(4) == 64
+
+    def test_batches_iterator_pads(self, tmp_path):
+        sampler, ds, _ = make_sampler(tmp_path)
+        it = curriculum_batches(ds, sampler)
+        b = next(it)
+        assert b["input_ids"].shape == b["loss_mask"].shape
+        assert b["input_ids"].shape[0] == 8
+        assert (b["loss_mask"].sum(1) >= 4).all()
+
+    def test_bad_config_rejects(self, tmp_path):
+        sampler, _, _ = make_sampler(tmp_path)
+        with pytest.raises(ValueError, match="percentile"):
+            DeepSpeedDataSampler(sampler.values, sampler.order,
+                                 sampler.curriculum, 8,
+                                 difficulty_type="nope")
+        with pytest.raises(ValueError, match="divide"):
+            DeepSpeedDataSampler(sampler.values, sampler.order,
+                                 sampler.curriculum, 7, dp_world=2)

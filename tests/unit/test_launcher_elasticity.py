@@ -175,3 +175,71 @@ class TestElasticity:
                               "model_parallel_size": 3}}
         with pytest.raises(ElasticityError, match="divide"):
             compute_elastic_config(cfg, world_size=8)
+
+
+class TestElasticAgent:
+    """Reference `elastic_agent.py:23,115`: monitor the worker group,
+    re-rendezvous survivors at a valid smaller world on failure."""
+
+    CFG = {"elasticity": {"enabled": True, "micro_batch_sizes": [1, 2, 4],
+                          "max_acceptable_batch_size": 16,
+                          "min_gpus": 1, "max_gpus": 8, "version": 0.1}}
+
+    def _spec(self, tmp_path, script):
+        import sys
+        p = tmp_path / "worker.py"
+        p.write_text(script)
+        from deepspeed_tpu.elasticity import WorkerSpec
+        return WorkerSpec(argv=[sys.executable, str(p)])
+
+    def test_rerendezvous_after_worker_death(self, tmp_path):
+        from deepspeed_tpu.elasticity import ElasticAgent
+        # generation 1: the highest rank dies; generation 2 must succeed
+        # at a smaller valid world. Workers log their (gen, world, rank).
+        script = f"""
+import os, sys
+gen = int(os.environ["ELASTIC_RESTART_COUNT"])
+world = int(os.environ["WORLD_SIZE"])
+rank = int(os.environ["RANK"])
+with open(r"{tmp_path}/log_g{{}}_w{{}}_r{{}}".format(gen, world, rank), "w"):
+    pass
+if gen == 0 and rank == world - 1:
+    sys.exit(1)
+sys.exit(0)
+"""
+        rendezvous = []
+        agent = ElasticAgent(
+            self._spec(tmp_path, script), self.CFG, initial_world_size=8,
+            monitor_interval=0.05,
+            on_rendezvous=lambda g, w: rendezvous.append((g, w)))
+        res = agent.run()
+        assert res.success
+        assert res.generations == 2
+        assert res.failed_slots == 1
+        # 8 slots -> 7 surviving -> largest valid <= 7 (valid set from the
+        # v0.1 solver over micro batches {1,2,4}, max batch 16)
+        assert res.final_world_size == rendezvous[-1][1]
+        assert res.final_world_size < 8
+        assert res.final_world_size in agent.valid_worlds
+        # all generation-2 workers actually ran at the new world size
+        logs = sorted(f.name for f in tmp_path.glob("log_g1_*"))
+        assert len(logs) == res.final_world_size
+
+    def test_gives_up_after_max_restarts(self, tmp_path):
+        from deepspeed_tpu.elasticity import ElasticAgent
+        script = "import sys; sys.exit(1)\n"
+        agent = ElasticAgent(self._spec(tmp_path, script), self.CFG,
+                             initial_world_size=4, monitor_interval=0.05,
+                             max_restarts=2)
+        res = agent.run()
+        assert not res.success
+
+    def test_no_valid_world_raises_upfront(self, tmp_path):
+        from deepspeed_tpu.elasticity import ElasticAgent, ElasticityError
+        import pytest
+        cfg = {"elasticity": {"enabled": True, "micro_batch_sizes": [8],
+                              "max_acceptable_batch_size": 64,
+                              "min_gpus": 4, "max_gpus": 8, "version": 0.1}}
+        with pytest.raises(ElasticityError, match="no valid world"):
+            ElasticAgent(self._spec(tmp_path, "pass"), cfg,
+                         initial_world_size=2).run()

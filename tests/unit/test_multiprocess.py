@@ -1,0 +1,99 @@
+"""Real multi-process distributed tests (VERDICT r2 #9).
+
+Forks 2 processes x 2 CPU devices each through `dist_harness` — covering
+`jax.distributed` bring-up, comm.init_distributed, the engine's
+process_count>1 batch assembly, cross-process collectives inside the
+train step, and a checkpoint written collectively by all processes.
+Reference: `tests/unit/common.py:69` DistributedExec.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from dist_harness import run_distributed
+
+pytestmark = pytest.mark.multiprocess
+
+
+class TestDistributed:
+    def test_comm_init_and_allreduce(self):
+        run_distributed("""
+import jax, jax.numpy as jnp, numpy as np
+import deepspeed_tpu as ds
+from deepspeed_tpu import comm
+comm.init_distributed()     # already-initialized jax.distributed: no-op
+assert comm.get_world_size() == 2
+assert comm.get_rank() == process_id
+from jax.sharding import Mesh, PartitionSpec as P, NamedSharding
+mesh = Mesh(np.array(jax.devices()).reshape(4), ("data",))
+local = np.full((2, 4), float(process_id + 1), np.float32)
+x = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("data")), local, (4, 4))
+out = jax.jit(jax.shard_map(lambda v: jax.lax.pmean(v, "data"),
+    mesh=mesh, in_specs=P("data"), out_specs=P()),
+    out_shardings=NamedSharding(mesh, P()))(x)
+got = np.asarray(jax.device_get(out.addressable_data(0)))
+np.testing.assert_allclose(got, 1.5)
+print("rank", process_id, "allreduce ok")
+""")
+
+    def test_dp_train_step_agrees_across_processes(self):
+        tmp = run_distributed("""
+import jax, jax.numpy as jnp, numpy as np
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import TransformerLM, gpt2_config
+cfg = gpt2_config("125m", num_layers=2, d_model=32, num_heads=4,
+                  vocab_size=64, max_seq_len=16, loss_chunk=0,
+                  dtype=jnp.float32)
+engine, _, _, _ = ds.initialize(model=TransformerLM(cfg), config={
+    "train_micro_batch_size_per_gpu": 2,
+    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+    "mesh": {"data": 4}, "steps_per_print": 0},
+    rng=jax.random.PRNGKey(0))
+assert engine.train_batch_size == 8          # 2 micro x 4 global chips
+rs = np.random.RandomState(42)
+full = rs.randint(0, 64, (3, 8, 16), dtype=np.int32)   # same on all ranks
+losses = []
+for step in range(3):
+    local = full[step, process_id * 4:(process_id + 1) * 4]
+    m = engine.train_step({"input_ids": local})
+    losses.append(float(m["loss"]))
+with open(f"{tmp}/losses_{process_id}", "w") as f:
+    f.write(",".join(f"{x:.8f}" for x in losses))
+assert losses[-1] < losses[0] + 0.1
+print("rank", process_id, "losses", losses)
+""")
+        l0 = open(os.path.join(tmp, "losses_0")).read()
+        l1 = open(os.path.join(tmp, "losses_1")).read()
+        assert l0 == l1, (l0, l1)   # bitwise-identical metrics across ranks
+
+    def test_checkpoint_roundtrip_multiprocess(self):
+        tmp = run_distributed("""
+import jax, jax.numpy as jnp, numpy as np
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import TransformerLM, gpt2_config
+cfg = gpt2_config("125m", num_layers=2, d_model=32, num_heads=4,
+                  vocab_size=64, max_seq_len=16, loss_chunk=0,
+                  dtype=jnp.float32)
+def build(rng):
+    e, _, _, _ = ds.initialize(model=TransformerLM(cfg), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "mesh": {"data": 4}, "steps_per_print": 0},
+        rng=jax.random.PRNGKey(rng))
+    return e
+engine = build(0)
+rs = np.random.RandomState(1)
+batch = rs.randint(0, 64, (8, 16), dtype=np.int32)
+local = batch[process_id * 4:(process_id + 1) * 4]
+engine.train_step({"input_ids": local})
+engine.save_checkpoint(f"{tmp}/ckpt", tag="t1")
+m_before = engine.train_step({"input_ids": local})
+e2 = build(7)                               # different init
+e2.load_checkpoint(f"{tmp}/ckpt")
+m_after = e2.train_step({"input_ids": local})
+assert abs(float(m_before["loss"]) - float(m_after["loss"])) < 1e-6, (
+    float(m_before["loss"]), float(m_after["loss"]))
+print("rank", process_id, "checkpoint roundtrip ok")
+""")
