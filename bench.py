@@ -23,6 +23,59 @@ def chip_peak_flops(device) -> float:
     return _peak(device)
 
 
+def measure_roofline():
+    """What the silicon behind the tunnel actually delivers (VERDICT r2
+    #3: the measured ceiling belongs IN-BAND, not in a side file).
+
+    Two chained probes (each dispatch consumes the previous output — the
+    tunnel elides repeated identical dispatches):
+      - bf16 GEMM chain at the model's own [B*T, d] x [d, 4d] shapes
+      - elementwise multiply-add chain (HBM bandwidth)
+    """
+    import jax
+    import jax.numpy as jnp
+
+    # GEMM chain: x @ w1 @ w2, iterated INSIDE one compiled program
+    # (per-dispatch tunnel latency would otherwise dominate and understate
+    # the ceiling by several x)
+    m, d, f = 16384, 768, 3072
+    inner = 40
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(m, d), jnp.bfloat16)
+    w1 = jnp.asarray(rs.randn(d, f) * 0.02, jnp.bfloat16)
+    w2 = jnp.asarray(rs.randn(f, d) * 0.02, jnp.bfloat16)
+
+    @jax.jit
+    def gemm_chain(x):
+        return jax.lax.fori_loop(0, inner, lambda i, a: (a @ w1) @ w2, x)
+
+    x1 = gemm_chain(x)
+    x1.block_until_ready()
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        x1 = gemm_chain(x1)
+    x1.block_until_ready()
+    dt = time.perf_counter() - t0
+    gemm_tflops = 2 * 2 * m * d * f * inner * reps / dt / 1e12
+
+    big = jnp.asarray(np.random.default_rng(0).standard_normal(
+        64 << 20, dtype=np.float32))  # 256 MB, allocated f32 directly
+
+    @jax.jit
+    def ew_chain(a):
+        return jax.lax.fori_loop(
+            0, 20, lambda i, a: a * 1.0000001 + 0.0000001, a)
+
+    y = ew_chain(big)
+    y.block_until_ready()
+    t0 = time.perf_counter()
+    y = ew_chain(y)
+    y.block_until_ready()
+    hbm_gbps = 2 * big.nbytes * 20 / (time.perf_counter() - t0) / 2**30
+    return round(gemm_tflops, 1), round(hbm_gbps, 1)
+
+
 def main():
     import jax
     import deepspeed_tpu as ds
@@ -77,14 +130,39 @@ def main():
     n_params = engine.num_parameters()
     # fwd+bwd FLOPs: 6 * N per token + attention term 12 * L * d * s
     flops_per_tok = 6 * n_params + 12 * cfg.num_layers * cfg.d_model * seq
-    mfu = tok_per_sec * flops_per_tok / chip_peak_flops(dev)
+    nominal_peak = chip_peak_flops(dev)
+    mfu = tok_per_sec * flops_per_tok / nominal_peak
 
-    print(json.dumps({
+    out = {
         "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
         "value": round(tok_per_sec, 1),
         "unit": "tokens/s",
+        # the contract number: MFU against the NOMINAL chip peak, over the
+        # 45% north-star target
         "vs_baseline": round(mfu / 0.45, 4),
-    }))
+    }
+    if on_tpu:
+        # measured roofline, in-band: this tunnel's silicon delivers a
+        # fraction of nominal peak even for pure GEMM chains; judge the
+        # train step against what the hardware can actually do.
+        gemm_tf, hbm_gbps = measure_roofline()
+        achieved_tf = tok_per_sec * flops_per_tok / 1e12
+        out.update({
+            "mfu_nominal": round(mfu, 4),
+            "measured_gemm_tflops": gemm_tf,       # chain-GEMM ceiling
+            "measured_hbm_gbps": hbm_gbps,
+            "nominal_tflops": round(nominal_peak / 1e12, 1),
+            "achieved_tflops": round(achieved_tf, 1),
+            # achieved model FLOPs over the MEASURED GEMM ceiling — the
+            # hardware-bounded utilization...
+            "mfu_vs_measured_peak": round(
+                achieved_tf / max(gemm_tf, 1e-9), 4),
+            # ...over the same 45% bar: >1.0 = beats the target on the
+            # hardware actually present
+            "vs_baseline_measured_peak": round(
+                achieved_tf / max(gemm_tf, 1e-9) / 0.45, 4),
+        })
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
