@@ -67,6 +67,9 @@ class TransformerConfig:
     parallel_residual: bool = False    # NeoX-style x + attn(ln1 x) + mlp(ln2 x)
     norm_type: str = "layernorm"       # layernorm | rmsnorm
     activation: str = "gelu"
+    # gated MLP (SwiGLU — the LLaMA family): act(gate(x)) * up(x) -> down;
+    # adds a "fc_gate" kernel per block
+    gated_mlp: bool = False
     use_bias: bool = True
     tie_embeddings: bool = True
     dtype: Any = jnp.bfloat16          # activation dtype
@@ -142,8 +145,12 @@ class TransformerConfig:
         nhd = self.num_heads * self.hdim
         norm = 2 * d if self.norm_type == "layernorm" else d
         per_layer = d * 3 * nhd + nhd * d + 2 * d * f + 2 * norm
+        if self.gated_mlp:
+            per_layer += d * f
         if self.use_bias:
             per_layer += 3 * nhd + d + f + d
+            if self.gated_mlp:
+                per_layer += f
         emb = v * d + (self.max_seq_len * d if self.pos_embedding == "learned" else 0)
         head = 0 if self.tie_embeddings else d * v
         return self.num_layers * per_layer + emb + head + norm
@@ -240,13 +247,16 @@ class TransformerLM:
     def _block_init(self, k):
         c, dt = self.config, self.config.param_dtype
         d, f = c.d_model, c.ff_dim
-        ka, k3, k4 = jax.random.split(k, 3)
+        ka, k3, k4, k5 = jax.random.split(k, 4)
         blk = self._attn_block_init(ka)
         blk["mlp"] = {
             "fc_in": L.dense_init(k3, d, f, c.use_bias, 0.02, dt),
             "fc_out": {"kernel": L.scaled_init(k4, (f, d), 0.02,
                                                c.num_layers, dt)},
         }
+        if c.gated_mlp:
+            blk["mlp"]["fc_gate"] = L.dense_init(k5, d, f, c.use_bias,
+                                                 0.02, dt)
         if c.use_bias:
             blk["mlp"]["fc_out"]["bias"] = jnp.zeros((d,), dt)
         return blk
@@ -449,7 +459,13 @@ class TransformerLM:
         return L.dense_apply(p["out"], o), new_cache
 
     def _mlp(self, p, x):
-        h = L.dense_apply(p["fc_in"], self._maybe_qact(x))
+        xq = self._maybe_qact(x)
+        if self.config.gated_mlp:
+            g = L.ACT_FNS[self.config.activation](
+                L.dense_apply(p["fc_gate"], xq))
+            return L.dense_apply(p["fc_out"],
+                                 g * L.dense_apply(p["fc_in"], xq))
+        h = L.dense_apply(p["fc_in"], xq)
         h = L.ACT_FNS[self.config.activation](h)
         return L.dense_apply(p["fc_out"], h)
 
@@ -753,6 +769,8 @@ class TransformerLM:
         ("out", "bias"): (None,),
         ("fc_in", "kernel"): (None, "model"),
         ("fc_in", "bias"): ("model",),
+        ("fc_gate", "kernel"): (None, "model"),
+        ("fc_gate", "bias"): ("model",),
         ("fc_out", "kernel"): ("model", None),
         ("fc_out", "bias"): (None,),
         ("lm_head", "kernel"): (None, "model"),

@@ -427,6 +427,90 @@ def load_hf_bert(state_dict: Dict[str, Any],
     return params
 
 
+def hf_llama_config(hf_cfg, **overrides) -> TransformerConfig:
+    """transformers.LlamaConfig → TransformerConfig: RMSNorm + SwiGLU
+    gated MLP + full-dim rotate-half rotary, no biases, untied head.
+    Grouped-query attention (num_key_value_heads < num_attention_heads)
+    is not supported — rejected loudly."""
+    nkv = getattr(hf_cfg, "num_key_value_heads",
+                  hf_cfg.num_attention_heads)
+    if nkv != hf_cfg.num_attention_heads:
+        raise NotImplementedError(
+            f"LLaMA grouped-query attention (num_key_value_heads={nkv} < "
+            f"heads={hf_cfg.num_attention_heads}) is not supported — the "
+            f"fused qkv layout assumes MHA")
+    if getattr(hf_cfg, "rope_scaling", None):
+        raise NotImplementedError(
+            f"rope_scaling={hf_cfg.rope_scaling!r} (Llama-3 / long-context "
+            f"RoPE rescaling) is not implemented — converting without it "
+            f"would yield silently wrong logits")
+    if getattr(hf_cfg, "attention_bias", False):
+        raise NotImplementedError(
+            "attention_bias=True checkpoints carry q/k/v biases this "
+            "no-bias conversion would drop")
+    return TransformerConfig(
+        vocab_size=hf_cfg.vocab_size,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        num_layers=hf_cfg.num_hidden_layers,
+        num_heads=hf_cfg.num_attention_heads,
+        d_model=hf_cfg.hidden_size,
+        d_ff=hf_cfg.intermediate_size,
+        pos_embedding="rotary",
+        rotary_pct=1.0,
+        rotary_base=getattr(hf_cfg, "rope_theta", 10000.0),
+        rotary_interleaved=False,     # HF llama rotate_half
+        parallel_residual=False,
+        norm_type="rmsnorm",
+        activation=_map_act(hf_cfg.hidden_act),
+        gated_mlp=True,
+        use_bias=False,
+        tie_embeddings=bool(getattr(hf_cfg, "tie_word_embeddings", False)),
+        layernorm_eps=hf_cfg.rms_norm_eps,
+        **overrides)
+
+
+def load_hf_llama(state_dict: Dict[str, Any],
+                  config: TransformerConfig) -> Dict:
+    """HF LLaMA state dict → params (torch kernels transpose; q|k|v
+    concat to the fused layout; gate/up/down → fc_gate/fc_in/fc_out)."""
+    sd = {k.replace("model.", "", 1): v for k, v in state_dict.items()}
+    n = config.num_layers
+
+    def t(name, i):
+        return _np(sd[f"layers.{i}.{name}.weight"]).T
+
+    qkv_w = np.stack([np.concatenate(
+        [t("self_attn.q_proj", i), t("self_attn.k_proj", i),
+         t("self_attn.v_proj", i)], axis=-1) for i in range(n)])
+
+    def blk_t(name):
+        return np.stack([t(name, i) for i in range(n)])
+
+    def blk_ln(name):
+        return _stack(sd, "layers.{i}." + name + ".weight", n)
+
+    params = {
+        "embed": {"embedding": _np(sd["embed_tokens.weight"])},
+        "blocks": {
+            "ln1": {"scale": blk_ln("input_layernorm")},
+            "attn": {
+                "qkv": {"kernel": qkv_w},
+                "out": {"kernel": blk_t("self_attn.o_proj")},
+            },
+            "ln2": {"scale": blk_ln("post_attention_layernorm")},
+            "mlp": {
+                "fc_gate": {"kernel": blk_t("mlp.gate_proj")},
+                "fc_in": {"kernel": blk_t("mlp.up_proj")},
+                "fc_out": {"kernel": blk_t("mlp.down_proj")},
+            },
+        },
+        "ln_f": {"scale": _np(sd["norm.weight"])},
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = {"kernel": _np(state_dict["lm_head.weight"]).T}
+    return params
+
+
 # registry (reference replace_policy.py:17)
 POLICIES = {
     "gpt2": (hf_gpt2_config, load_hf_gpt2),
@@ -434,6 +518,7 @@ POLICIES = {
     "opt": (hf_opt_config, load_hf_opt),
     "bloom": (hf_bloom_config, load_hf_bloom),
     "bert": (hf_bert_config, load_hf_bert),
+    "llama": (hf_llama_config, load_hf_llama),
 }
 
 

@@ -243,6 +243,37 @@ class TestHFPolicies:
                                      token_type_ids=jnp.asarray(tts)))
         np.testing.assert_allclose(got, want, atol=2e-3)
 
+    def test_llama_logit_parity(self):
+        """LLaMA family: RMSNorm + SwiGLU gated MLP + rotate-half rotary,
+        no biases, untied head."""
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=96, max_position_embeddings=64, hidden_size=48,
+            num_hidden_layers=3, num_attention_heads=4,
+            num_key_value_heads=4, intermediate_size=128,
+            hidden_act="silu", rms_norm_eps=1e-6,
+            attention_dropout=0.0, tie_word_embeddings=False)
+        hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+        from deepspeed_tpu.module_inject import convert_hf_model
+        cfg, params = convert_hf_model(hf, dtype=jnp.float32, loss_chunk=0)
+        assert cfg.gated_mlp and cfg.norm_type == "rmsnorm"
+        model = TransformerLM(cfg)
+        ids = np.random.RandomState(0).randint(0, 96, (2, 16))
+        with torch.no_grad():
+            want = hf(torch.tensor(ids)).logits.numpy()
+        got = np.asarray(model.apply(params, jnp.asarray(ids)))
+        np.testing.assert_allclose(got, want, atol=2e-3)
+
+    def test_llama_gqa_rejects(self):
+        transformers = pytest.importorskip("transformers")
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=96, hidden_size=48, num_hidden_layers=1,
+            num_attention_heads=4, num_key_value_heads=2,
+            intermediate_size=128)
+        from deepspeed_tpu.module_inject.policies import hf_llama_config
+        with pytest.raises(NotImplementedError, match="grouped-query"):
+            hf_llama_config(hf_cfg)
 
 class TestInt8Serving:
     def _models(self):
@@ -387,3 +418,4 @@ class TestChunkedDecodeKernel:
         np.testing.assert_allclose(np.asarray(o),
                                    np.asarray(self._ref(q, k, v, 4999)),
                                    atol=2e-4)
+
