@@ -181,6 +181,41 @@ def causal_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def gqa_attention(q, k, v, *, mask: Optional[jnp.ndarray] = None,
+                  scale: Optional[float] = None,
+                  kv_positions_offset: int = 0, causal: bool = True,
+                  bias: Optional[jnp.ndarray] = None):
+    """Grouped-query attention WITHOUT materializing expanded k/v:
+    q [B,Tq,H,Dh] with H = G·Hkv groups attends k/v [B,Tk,Hkv,Dh] via a
+    group einsum — peak working set stays at the kv-width cache (the
+    memory moment GQA exists for). ``mask`` broadcastable to
+    [B,1,1,Tq,Tk]; ``bias`` to [B,H,Tq,Tk] (regrouped internally)."""
+    b, tq, nh, hd = q.shape
+    nkv = k.shape[2]
+    g = nh // nkv
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    qg = q.reshape(b, tq, nkv, g, hd)
+    logits = jnp.einsum("btkgd,bskd->bkgts", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        bias = jnp.broadcast_to(
+            bias.astype(jnp.float32),
+            bias.shape[:-3] + (nh,) + bias.shape[-2:])
+        logits = logits + bias.reshape(bias.shape[:-3] + (nkv, g)
+                                       + bias.shape[-2:])
+    tk = k.shape[1]
+    if causal:
+        q_pos = jnp.arange(tq) + kv_positions_offset
+        cmask = q_pos[:, None] >= jnp.arange(tk)[None, :]
+        logits = jnp.where(cmask[None, None, None], logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v)
+    return out.reshape(b, tq, nh, hd)
+
+
 def alibi_slopes(num_heads: int) -> jnp.ndarray:
     """ALiBi head slopes (Press et al.; BLOOM's build_alibi_tensor,
     HF modeling_bloom.py): powers of 2^(-8/n) with the non-power-of-two

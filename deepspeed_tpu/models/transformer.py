@@ -40,6 +40,9 @@ class TransformerConfig:
     max_seq_len: int = 1024
     num_layers: int = 12
     num_heads: int = 12
+    # grouped-query attention: kv heads < query heads (LLaMA-2/3 70B
+    # family); 0 = MHA (kv heads == num_heads)
+    num_kv_heads: int = 0
     d_model: int = 768
     d_ff: int = 0                      # 0 → 4 * d_model
     head_dim: int = 0                  # 0 → d_model // num_heads
@@ -136,6 +139,19 @@ class TransformerConfig:
         return self.head_dim or self.d_model // self.num_heads
 
     @property
+    def kv_heads(self) -> int:
+        n = self.num_kv_heads or self.num_heads
+        if self.num_heads % n:
+            raise ValueError(f"num_heads {self.num_heads} must divide by "
+                             f"num_kv_heads {n}")
+        return n
+
+    @property
+    def qkv_dim(self) -> int:
+        """Fused projection output: q heads + 2x kv heads."""
+        return (self.num_heads + 2 * self.kv_heads) * self.hdim
+
+    @property
     def rotary_dim(self) -> int:
         d = int(self.hdim * self.rotary_pct)
         return d - d % 2
@@ -144,11 +160,11 @@ class TransformerConfig:
         d, f, v = self.d_model, self.ff_dim, self.vocab_size
         nhd = self.num_heads * self.hdim
         norm = 2 * d if self.norm_type == "layernorm" else d
-        per_layer = d * 3 * nhd + nhd * d + 2 * d * f + 2 * norm
+        per_layer = d * self.qkv_dim + nhd * d + 2 * d * f + 2 * norm
         if self.gated_mlp:
             per_layer += d * f
         if self.use_bias:
-            per_layer += 3 * nhd + d + f + d
+            per_layer += self.qkv_dim + d + f + d
             if self.gated_mlp:
                 per_layer += f
         emb = v * d + (self.max_seq_len * d if self.pos_embedding == "learned" else 0)
@@ -234,7 +250,7 @@ class TransformerLM:
         blk = {
             "ln1": norm_init(None, d, dt),
             "attn": {
-                "qkv": L.dense_init(k1, d, 3 * nh * hd, c.use_bias, 0.02, dt),
+                "qkv": L.dense_init(k1, d, c.qkv_dim, c.use_bias, 0.02, dt),
                 "out": {"kernel": L.scaled_init(k2, (nh * hd, d), 0.02,
                                                 c.num_layers, dt)},
             },
@@ -363,10 +379,14 @@ class TransformerLM:
     def _attention(self, p, x, cache_kv=None, positions=None):
         c = self.config
         nh, hd = c.num_heads, c.hdim
+        nkv = c.kv_heads
         qkv = L.dense_apply(p["qkv"], self._maybe_qact(x))
         b, t = qkv.shape[0], qkv.shape[1]
-        qkv = qkv.reshape(b, t, 3, nh, hd)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        # one layout for MHA and GQA: [q (nh) | k (nkv) | v (nkv)] heads
+        # (for nkv == nh this is exactly the fused [3, nh, hd] order)
+        q = qkv[..., :nh * hd].reshape(b, t, nh, hd)
+        k = qkv[..., nh * hd:(nh + nkv) * hd].reshape(b, t, nkv, hd)
+        v = qkv[..., (nh + nkv) * hd:].reshape(b, t, nkv, hd)
         if c.pos_embedding == "rotary":
             cos = self._cos.astype(jnp.float32)
             sin = self._sin.astype(jnp.float32)
@@ -374,8 +394,17 @@ class TransformerLM:
                                interleaved=c.rotary_interleaved)
             k = L.apply_rotary(k, cos, sin, positions,
                                interleaved=c.rotary_interleaved)
+        def expand_kv(a):
+            # GQA expansion for the Pallas/ring kernels (which assume one
+            # kv head per query head); the XLA paths use L.gqa_attention
+            # and never materialize this
+            return a if nkv == nh else jnp.repeat(a, nh // nkv, axis=2)
+
         new_cache = None
         offset = 0
+        if cache_kv is None and c.attn_impl in ("ring", "blocksparse",
+                                                "flash"):
+            k, v = expand_kv(k), expand_kv(v)
         if cache_kv is None and c.attn_impl == "ring":
             from ..ops.transformer.ring_attention import ring_attention
             from ..parallel.topology import SEQUENCE_AXIS
@@ -422,7 +451,6 @@ class TransformerLM:
                                               (0, idx, 0, 0))
             cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
                                               (0, idx, 0, 0))
-            k, v = ck, cv
             offset = idx
             new_cache = (ck, cv)
             tk = ck.shape[1]
@@ -437,24 +465,34 @@ class TransformerLM:
                 from ..ops.transformer import decode_attention as DA
                 if DA.supports(hd, tk):
                     o = DA.decode_attention(
-                        q[:, 0], k.astype(q.dtype), v.astype(q.dtype),
-                        idx + 1)[:, None]
+                        q[:, 0], expand_kv(ck).astype(q.dtype),
+                        expand_kv(cv).astype(q.dtype), idx + 1)[:, None]
                     o = o.reshape(b, t, nh * hd)
                     return L.dense_apply(p["out"], o), new_cache
-            valid = jnp.arange(tk)[None, None, None, :] < (idx + t)
             bias = None
             if c.pos_embedding == "alibi":
                 qpos = (positions[0] if positions is not None
                         else idx + jnp.arange(t))
                 bias = L.alibi_bias(nh, tk, qpos)[None]
-            o = L.causal_attention(q, k.astype(q.dtype), v.astype(q.dtype),
-                                   mask=valid, kv_positions_offset=offset,
-                                   bias=bias)
+            if nkv != nh:
+                valid = jnp.arange(tk)[None, None, None, None, :] < (idx + t)
+                o = L.gqa_attention(q, ck.astype(q.dtype),
+                                    cv.astype(q.dtype), mask=valid,
+                                    kv_positions_offset=offset, bias=bias)
+            else:
+                valid = jnp.arange(tk)[None, None, None, :] < (idx + t)
+                o = L.causal_attention(q, ck.astype(q.dtype),
+                                       cv.astype(q.dtype), mask=valid,
+                                       kv_positions_offset=offset,
+                                       bias=bias)
         else:
             bias = None
             if c.pos_embedding == "alibi":
                 bias = L.alibi_bias(nh, t, jnp.arange(t))[None]
-            o = L.causal_attention(q, k, v, causal=c.causal, bias=bias)
+            if nkv != nh:
+                o = L.gqa_attention(q, k, v, causal=c.causal, bias=bias)
+            else:
+                o = L.causal_attention(q, k, v, causal=c.causal, bias=bias)
         o = o.reshape(b, t, nh * hd)
         return L.dense_apply(p["out"], o), new_cache
 
@@ -674,9 +712,9 @@ class TransformerLM:
         dtype = dtype or c.dtype
         if c.moe_enabled:
             shape = (c.scan_length, c.attn_per_block, batch, max_len,
-                     c.num_heads, c.hdim)
+                     c.kv_heads, c.hdim)
         else:
-            shape = (c.num_layers, batch, max_len, c.num_heads, c.hdim)
+            shape = (c.num_layers, batch, max_len, c.kv_heads, c.hdim)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
                 "index": jnp.array(0, jnp.int32)}
 

@@ -430,15 +430,11 @@ def load_hf_bert(state_dict: Dict[str, Any],
 def hf_llama_config(hf_cfg, **overrides) -> TransformerConfig:
     """transformers.LlamaConfig → TransformerConfig: RMSNorm + SwiGLU
     gated MLP + full-dim rotate-half rotary, no biases, untied head.
-    Grouped-query attention (num_key_value_heads < num_attention_heads)
-    is not supported — rejected loudly."""
+    Grouped-query attention (num_key_value_heads < heads) maps to
+    num_kv_heads; rope_scaling / bias-carrying checkpoints reject loudly
+    (converting them would yield silently wrong logits)."""
     nkv = getattr(hf_cfg, "num_key_value_heads",
                   hf_cfg.num_attention_heads)
-    if nkv != hf_cfg.num_attention_heads:
-        raise NotImplementedError(
-            f"LLaMA grouped-query attention (num_key_value_heads={nkv} < "
-            f"heads={hf_cfg.num_attention_heads}) is not supported — the "
-            f"fused qkv layout assumes MHA")
     if getattr(hf_cfg, "rope_scaling", None):
         raise NotImplementedError(
             f"rope_scaling={hf_cfg.rope_scaling!r} (Llama-3 / long-context "
@@ -448,11 +444,17 @@ def hf_llama_config(hf_cfg, **overrides) -> TransformerConfig:
         raise NotImplementedError(
             "attention_bias=True checkpoints carry q/k/v biases this "
             "no-bias conversion would drop")
+    if getattr(hf_cfg, "mlp_bias", False):
+        raise NotImplementedError(
+            "mlp_bias=True checkpoints carry gate/up/down biases this "
+            "no-bias conversion would drop")
     return TransformerConfig(
         vocab_size=hf_cfg.vocab_size,
         max_seq_len=hf_cfg.max_position_embeddings,
         num_layers=hf_cfg.num_hidden_layers,
         num_heads=hf_cfg.num_attention_heads,
+        num_kv_heads=(0 if nkv == hf_cfg.num_attention_heads else nkv),
+        head_dim=getattr(hf_cfg, "head_dim", None) or 0,
         d_model=hf_cfg.hidden_size,
         d_ff=hf_cfg.intermediate_size,
         pos_embedding="rotary",

@@ -265,14 +265,37 @@ class TestHFPolicies:
         got = np.asarray(model.apply(params, jnp.asarray(ids)))
         np.testing.assert_allclose(got, want, atol=2e-3)
 
-    def test_llama_gqa_rejects(self):
+    def test_llama_gqa_logit_parity(self):
+        """Grouped-query attention (LLaMA-2/3 70B family): kv heads <
+        query heads, cache stored at kv width."""
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=96, max_position_embeddings=64, hidden_size=48,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, intermediate_size=128,
+            hidden_act="silu", rms_norm_eps=1e-6, attention_dropout=0.0,
+            tie_word_embeddings=False)
+        hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+        from deepspeed_tpu.module_inject import convert_hf_model
+        cfg, params = convert_hf_model(hf, dtype=jnp.float32, loss_chunk=0)
+        assert cfg.num_kv_heads == 2
+        model = TransformerLM(cfg)
+        ids = np.random.RandomState(0).randint(0, 96, (2, 16))
+        with torch.no_grad():
+            want = hf(torch.tensor(ids)).logits.numpy()
+        got = np.asarray(model.apply(params, jnp.asarray(ids)))
+        np.testing.assert_allclose(got, want, atol=2e-3)
+
+    def test_llama_rope_scaling_rejects(self):
         transformers = pytest.importorskip("transformers")
         hf_cfg = transformers.LlamaConfig(
             vocab_size=96, hidden_size=48, num_hidden_layers=1,
-            num_attention_heads=4, num_key_value_heads=2,
-            intermediate_size=128)
+            num_attention_heads=4, num_key_value_heads=4,
+            intermediate_size=128,
+            rope_scaling={"rope_type": "linear", "factor": 2.0})
         from deepspeed_tpu.module_inject.policies import hf_llama_config
-        with pytest.raises(NotImplementedError, match="grouped-query"):
+        with pytest.raises(NotImplementedError, match="rope_scaling"):
             hf_llama_config(hf_cfg)
 
 class TestInt8Serving:
@@ -419,3 +442,37 @@ class TestChunkedDecodeKernel:
                                    np.asarray(self._ref(q, k, v, 4999)),
                                    atol=2e-4)
 
+
+
+class TestGQADecode:
+    def test_gqa_generate_matches_forward_argmax(self):
+        """Cached decode with kv heads < query heads: the cache stores nkv
+        heads (the GQA memory win) and greedy decode must agree with
+        full-forward argmax."""
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.models.transformer import TransformerConfig
+        cfg = TransformerConfig(
+            vocab_size=64, max_seq_len=64, num_layers=2, num_heads=4,
+            num_kv_heads=2, d_model=32, d_ff=64, gated_mlp=True,
+            norm_type="rmsnorm", use_bias=False, pos_embedding="rotary",
+            rotary_interleaved=False, tie_embeddings=False,
+            activation="silu", loss_chunk=0, dtype=jnp.float32)
+        model = TransformerLM(cfg)
+        params = jax.device_get(model.init(jax.random.PRNGKey(0)))
+        # cache is at kv width
+        cache = model.init_cache(2, 32)
+        assert cache["k"].shape[-2] == 2
+        eng = ds.init_inference(TransformerLM(cfg), params=params,
+                                config={"dtype": "float32",
+                                        "max_out_tokens": 64,
+                                        "prompt_bucket": 0})
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 64, (2, 8)).astype(np.int32)
+        out = np.asarray(eng.generate(ids, max_new_tokens=4,
+                                      temperature=0.0))
+        cur = ids
+        for t in range(4):
+            logits = np.asarray(eng.forward(cur))
+            nxt = logits[:, -1].argmax(-1).astype(np.int32)
+            np.testing.assert_array_equal(out[:, t], nxt)
+            cur = np.concatenate([cur, nxt[:, None]], axis=1)

@@ -254,3 +254,36 @@ class TestMoETraining:
         l2 = float(engine2.train_step(batch(engine2.train_batch_size, seed=9))
                    ["loss"])
         assert abs(l1 - l2) < 1e-5
+
+
+class TestMoEInference:
+    """MoE serving (reference ops/transformer/inference/moe_inference.py):
+    the compiled prefill+decode loop over an expert-parallel model."""
+
+    def _moe_model(self):
+        from deepspeed_tpu.models import TransformerLM, gpt2_config
+        return TransformerLM(gpt2_config(
+            "125m", num_layers=2, d_model=32, num_heads=4, vocab_size=64,
+            max_seq_len=64, loss_chunk=0, dtype=jnp.float32,
+            moe_num_experts=4, moe_freq=2, moe_k=1, moe_use_rts=False))
+
+    def test_generate_runs_and_matches_forward_argmax(self):
+        import deepspeed_tpu as ds
+        model = self._moe_model()
+        params = jax.device_get(model.init(jax.random.PRNGKey(0)))
+        eng = ds.init_inference(self._moe_model(), params=params, config={
+            "dtype": "float32", "max_out_tokens": 64, "prompt_bucket": 0,
+            "moe": {"enabled": True, "ep_size": 2}})
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 64, (2, 8)).astype(np.int32)
+        out = np.asarray(eng.generate(ids, max_new_tokens=4,
+                                      temperature=0.0))
+        assert out.shape == (2, 4)
+        # greedy decode must agree with repeated full forwards (the cached
+        # expert-dispatch path vs the scan path)
+        cur = ids
+        for t in range(4):
+            logits = np.asarray(eng.forward(cur))
+            nxt = logits[:, -1].argmax(-1).astype(np.int32)
+            np.testing.assert_array_equal(out[:, t], nxt)
+            cur = np.concatenate([cur, nxt[:, None]], axis=1)
