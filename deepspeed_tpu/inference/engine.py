@@ -50,8 +50,12 @@ class InferenceEngine:
 
         # kernel injection: on a TransformerLM this toggles the Pallas
         # flash/decode attention path (the reference swaps in fused CUDA
-        # modules, replace_module.py:306; here kernels are a config bit)
-        if hasattr(getattr(model, "config", None), "attn_impl"):
+        # modules, replace_module.py:306; here kernels are a config bit).
+        # Only the xla<->flash pair is rewritten: blocksparse/ring are
+        # deliberate MODEL choices whose semantics (layouts, sequence
+        # sharding) must survive serving.
+        if hasattr(getattr(model, "config", None), "attn_impl") and \
+                model.config.attn_impl in ("xla", "flash"):
             import dataclasses as _dc
             want = "flash" if self.config.replace_with_kernel_inject else "xla"
             if model.config.attn_impl != want:

@@ -344,7 +344,40 @@ class TransformerLM:
         self.mesh = mesh
 
     _flash_fallback_warned = False
-    _blocksparse_decode_warned = False
+
+    def _sparse_decode_mask(self, idx, t: int, tk: int):
+        """[1, H|1, t, tk] bool: the training layout's block rows gathered
+        at the query positions — cached decode sees exactly the pattern
+        the model trained with (the block-level mask equivalent of the
+        blocksparse kernel's index walk)."""
+        c = self.config
+        if c.sparsity_config is None:
+            raise ValueError(
+                "attn_impl='blocksparse' needs sparsity_config for the "
+                "sparse decode mask")
+        blk = c.sparsity_config.block
+        nbk = -(-tk // blk)
+        # the layout is built at the TRAINING context length: stochastic
+        # layouts (BigBird random blocks) depend on the block count, so
+        # rebuilding at cache capacity would apply a pattern the model
+        # never trained with
+        nb_train = c.max_seq_len // blk
+        if nbk > nb_train:
+            raise NotImplementedError(
+                f"blocksparse decode cache ({tk} tokens) exceeds the "
+                f"training context ({c.max_seq_len}) — the layout beyond "
+                f"it is undefined; cap max_out_tokens at max_seq_len")
+        import numpy as _np
+        layout = _np.asarray(c.sparsity_config.make_layout(
+            nb_train * blk))
+        if layout.ndim == 2:
+            layout = layout[None]                     # [1|H, nb, nb]
+        layout = layout[:, :, :nbk]
+        layout_j = jnp.asarray(layout.astype(bool))
+        qpos = idx + jnp.arange(t)
+        rows = jnp.take(layout_j, qpos // blk, axis=1)    # [H?, t, nbk]
+        kmask = jnp.repeat(rows, blk, axis=-1)[..., :tk]  # [H?, t, tk]
+        return kmask[None]                                # [1, H|1, t, tk]
 
     def _warn_flash_fallback(self, tq: int, tk: int) -> None:
         """Loud (once) on the flash→XLA perf cliff — a silent fallback hides
@@ -423,7 +456,15 @@ class TransformerLM:
                     "attn_impl='blocksparse' needs sparsity_config (an "
                     "ops.sparse_attention.SparsityConfig instance) on the "
                     "TransformerConfig")
-            o = blocksparse_attention_bthd(q, k, v, c.sparsity_config)
+            if t % c.sparsity_config.block == 0:
+                o = blocksparse_attention_bthd(q, k, v, c.sparsity_config)
+            else:
+                # non-block-divisible length (e.g. mid-generation full
+                # forwards): masked dense with the SAME layout — identical
+                # semantics, without the kernel's divisibility constraint
+                mask = self._sparse_decode_mask(jnp.asarray(0, jnp.int32),
+                                                t, t)
+                o = L.causal_attention(q, k, v, mask=mask, causal=c.causal)
             o = o.reshape(b, t, nh * hd)
             return L.dense_apply(p["out"], o), None
         if cache_kv is None and c.attn_impl == "flash" and \
@@ -436,16 +477,6 @@ class TransformerLM:
                 return L.dense_apply(p["out"], o), None
             self._warn_flash_fallback(q.shape[1], k.shape[1])
         if cache_kv is not None:
-            if c.attn_impl == "blocksparse" and \
-                    not TransformerLM._blocksparse_decode_warned:
-                from ..utils.logging import logger
-                logger.warning(
-                    "attn_impl='blocksparse' decodes with DENSE causal "
-                    "attention over the KV cache — every token sees full "
-                    "history, unlike the sparse pattern used in training. "
-                    "Expect degraded generations for window-limited "
-                    "layouts; a sparse decode path is not built yet.")
-                TransformerLM._blocksparse_decode_warned = True
             ck, cv, idx = cache_kv
             ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
                                               (0, idx, 0, 0))
@@ -474,13 +505,27 @@ class TransformerLM:
                 qpos = (positions[0] if positions is not None
                         else idx + jnp.arange(t))
                 bias = L.alibi_bias(nh, tk, qpos)[None]
+            sparse_mask = None
+            if c.attn_impl == "blocksparse":
+                # decode applies the SAME layout the model trained with
+                # (block-row gathered at the query positions) — dense
+                # fallback would let every token see full history
+                sparse_mask = self._sparse_decode_mask(idx, t, tk)
             if nkv != nh:
                 valid = jnp.arange(tk)[None, None, None, None, :] < (idx + t)
+                if sparse_mask is not None:
+                    sm = (sparse_mask[:, :, None]      # [1,1,1,t,tk]
+                          if sparse_mask.shape[1] == 1
+                          else sparse_mask.reshape(1, nkv, nh // nkv, t,
+                                                   tk))
+                    valid = valid & sm
                 o = L.gqa_attention(q, ck.astype(q.dtype),
                                     cv.astype(q.dtype), mask=valid,
                                     kv_positions_offset=offset, bias=bias)
             else:
                 valid = jnp.arange(tk)[None, None, None, :] < (idx + t)
+                if sparse_mask is not None:
+                    valid = valid & sparse_mask
                 o = L.causal_attention(q, ck.astype(q.dtype),
                                        cv.astype(q.dtype), mask=valid,
                                        kv_positions_offset=offset,

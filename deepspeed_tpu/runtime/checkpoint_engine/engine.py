@@ -269,6 +269,51 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     return path, meta.get("client_state", {})
 
 
+def _infinity_fp32_state_dict(inf_path: str):
+    """Rebuild the full fp32 param tree from a ZeRO-Infinity checkpoint's
+    flat host-store slots, using the leaf layout recorded in its meta —
+    no live engine needed (the offline half of zero_to_fp32 for the
+    streamed path)."""
+    with open(os.path.join(inf_path, "meta.json")) as f:
+        meta = json.load(f)
+    if "layer_leaves" not in meta:
+        raise ValueError(
+            f"{inf_path} was saved before leaf layouts were recorded — "
+            f"load it through a live engine instead")
+    L = meta["L"]
+    rows = []
+    for i in range(L):
+        with np.load(os.path.join(inf_path, f"slot_{i:05d}.npz")) as z:
+            rows.append(z["p"])
+    slots = np.stack(rows)                       # [L, n_elems] fp32
+
+    def nest(tree, path, arr):
+        parts = path.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+
+    params: dict = {}
+    off = 0
+    blocks: dict = {}
+    for leaf in meta["layer_leaves"]:
+        size = int(np.prod(leaf["shape"])) if leaf["shape"] else 1
+        arr = slots[:, off:off + size].reshape([L] + leaf["shape"])
+        nest(blocks, leaf["path"], arr)
+        off += size
+    if off != slots.shape[1]:
+        raise ValueError(
+            f"infinity checkpoint layout mismatch: leaf shapes cover {off} "
+            f"elements but slots hold {slots.shape[1]} — meta.json was "
+            f"written by a different model revision")
+    params["blocks"] = blocks
+    with np.load(os.path.join(inf_path, "resident.npz")) as z:
+        for j, leaf in enumerate(meta["res_leaves"]):
+            nest(params, leaf["path"], np.asarray(z[f"master_{j}"]))
+    return params
+
+
 def get_fp32_state_dict_from_zero_checkpoint(load_dir: str,
                                              tag: Optional[str] = None):
     """Offline full-precision reconstruction — role of the reference's
@@ -278,6 +323,9 @@ def get_fp32_state_dict_from_zero_checkpoint(load_dir: str,
         with open(os.path.join(load_dir, "latest")) as f:
             tag = f.read().strip()
     path = _tag_path(load_dir, tag)
+    inf_path = os.path.join(path, "infinity")
+    if os.path.isdir(inf_path):
+        return _infinity_fp32_state_dict(inf_path)
     ckptr = _checkpointer()
     restored = ckptr.restore(os.path.join(path, "state"))
     params = jax.tree_util.tree_map(

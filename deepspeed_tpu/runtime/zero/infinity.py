@@ -691,11 +691,29 @@ class InfinityStepper:
         np.savez(os.path.join(path, "resident.npz"),
                  **{f"{k}_{j}": a for k, arrs in res.items()
                     for j, a in enumerate(arrs)})
+
+        def path_str(p):
+            return "/".join(str(getattr(x, "key", x)) for x in p)
+        # shape-only templates from __init__ — no device transfers here
+        layer_tpl = jax.eval_shape(self.model.init_superblock,
+                                   jax.random.PRNGKey(0))
+        layer_leaves = [
+            {"path": path_str(p), "shape": list(l.shape)}
+            for p, l in jax.tree_util.tree_flatten_with_path(layer_tpl)[0]]
+        res_leaves = [
+            {"path": path_str(p), "shape": list(l.shape)}
+            for p, l in jax.tree_util.tree_flatten_with_path(
+                self.resident_tpl)[0]]
         with open(os.path.join(path, "meta.json"), "w") as f:
             json.dump({"L": self.L, "n_elems": self.n_elems,
                        "step_count": self.opt.step_count,
                        "res_step_count": self.res_step_count,
-                       "n_res_leaves": len(res["master"])}, f)
+                       "n_res_leaves": len(res["master"]),
+                       # leaf layout: lets offline tools (universal
+                       # checkpoint export) rebuild the full fp32 tree
+                       # from the flat slots without a live engine
+                       "layer_leaves": layer_leaves,
+                       "res_leaves": res_leaves}, f)
 
     @property
     def res_step_count(self) -> int:

@@ -354,3 +354,36 @@ class TestInfinityEngine:
         cfg["fp16"] = {"enabled": True}
         with pytest.raises(NotImplementedError, match="bf16"):
             DeepSpeedEngine(tiny_model(), config=cfg, rng=rng, mesh=single_mesh())
+
+    def test_universal_export_from_infinity_checkpoint(self, tmp_path):
+        """zero_to_fp32 + universal export work OFFLINE from the streamed
+        checkpoint's flat slots (leaf layout in meta) and match the live
+        gather."""
+        from deepspeed_tpu.checkpoint.universal import (export_universal,
+                                                        load_universal,
+                                                        unflatten)
+        from deepspeed_tpu.runtime.checkpoint_engine.engine import (
+            get_fp32_state_dict_from_zero_checkpoint)
+        rng = jax.random.PRNGKey(0)
+        ids = ids_batch()
+        a = DeepSpeedEngine(tiny_model(),
+                            config=engine_cfg(zero=infinity_zero()),
+                            rng=rng, mesh=single_mesh())
+        a.train_step({"input_ids": ids})
+        a.save_checkpoint(str(tmp_path / "ck"), tag="t")
+        live = a._infinity.gather_params()
+        offline = get_fp32_state_dict_from_zero_checkpoint(
+            str(tmp_path / "ck"), "t")
+        for (pa, la), (pb, lb) in zip(
+                jax.tree_util.tree_flatten_with_path(live)[0],
+                jax.tree_util.tree_flatten_with_path(offline)[0]):
+            np.testing.assert_allclose(np.asarray(la), lb, atol=1e-7,
+                                       err_msg=str(pa))
+        out = export_universal(str(tmp_path / "ck"), str(tmp_path / "uni"),
+                               tag="t")
+        flat = load_universal(out)
+        tree = unflatten(flat)
+        np.testing.assert_allclose(
+            tree["blocks"]["mlp"]["fc_in"]["kernel"],
+            np.asarray(live["blocks"]["mlp"]["fc_in"]["kernel"]),
+            atol=1e-7)
