@@ -415,11 +415,13 @@ class TransformerLM:
         nkv = c.kv_heads
         qkv = L.dense_apply(p["qkv"], self._maybe_qact(x))
         b, t = qkv.shape[0], qkv.shape[1]
-        # one layout for MHA and GQA: [q (nh) | k (nkv) | v (nkv)] heads
-        # (for nkv == nh this is exactly the fused [3, nh, hd] order)
-        q = qkv[..., :nh * hd].reshape(b, t, nh, hd)
-        k = qkv[..., nh * hd:(nh + nkv) * hd].reshape(b, t, nkv, hd)
-        v = qkv[..., (nh + nkv) * hd:].reshape(b, t, nkv, hd)
+        if nkv == nh:
+            qkv3 = qkv.reshape(b, t, 3, nh, hd)
+            q, k, v = qkv3[:, :, 0], qkv3[:, :, 1], qkv3[:, :, 2]
+        else:
+            q = qkv[..., :nh * hd].reshape(b, t, nh, hd)
+            k = qkv[..., nh * hd:(nh + nkv) * hd].reshape(b, t, nkv, hd)
+            v = qkv[..., (nh + nkv) * hd:].reshape(b, t, nkv, hd)
         if c.pos_embedding == "rotary":
             cos = self._cos.astype(jnp.float32)
             sin = self._sin.astype(jnp.float32)
