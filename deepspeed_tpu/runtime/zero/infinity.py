@@ -21,8 +21,10 @@ redesigned for the XLA compilation model:
 
 Device HBM therefore holds: the resident params (embeddings, final norm,
 head — fp32 masters, optimizer-stepped on host), TWO layer-parameter
-buffers, one layer's VJP residuals, and the [B,T,D] activation stash —
-independent of depth x width. Host tiers:
+buffers and one layer's VJP residuals (both independent of depth), plus
+the activation stash — one [B,T,D] tensor PER layer, i.e. linear in depth
+(it is the parameter/optimizer memory that goes beyond-HBM, not
+activations; shrink the stash with remat or micro-batching). Host tiers:
 
   offload_param.device:      cpu (DRAM byte store) | nvme (file + aio)
   offload_optimizer.device:  cpu | nvme  (master|m|v slots, SlotOptimizer)
@@ -34,8 +36,21 @@ Two step modes:
             host fp32 store; one pipelined optimizer sweep at the boundary
             (the reference's pattern for the same configs).
 
-Restrictions (all raised loudly): single-device mesh (the multi-chip path
-is ZeRO-3 sharding, `runtime/zero/sharding.py`), bf16 compute (no fp16
+Multi-chip composition (ZeRO-3 x Infinity): on a data-parallel mesh the
+flat layer vector is padded to a multiple of the dp width and sharded
+``P(data)`` — each chip's HBM holds 1/D of the two layer buffers, XLA
+all-gathers the vector at use inside ``block_fwd`` and reduce-scatters
+``dflat`` back to shards (the GSPMD re-expression of the reference's
+rank-partitioned swap, `runtime/zero/stage3.py:480`
+_configure_tensor_swapping + `partitioned_param_swapper.py:35` per-rank
+partition IO). Host slot stores are sized to the PROCESS-LOCAL span of the
+shard axis, so on a multi-host pod each host streams only its ranks'
+partitions over PCIe/NVMe while the gather rides ICI. Batches shard over
+the same axis; the host Adam sweep is untouched (it just sees a shorter
+vector per process).
+
+Restrictions (all raised loudly): data-parallel-only meshes (model/pipe/
+sequence/expert axes must be 1 under offload), bf16 compute (no fp16
 loss scaling), dense blocks (no MoE), Adam/AdamW.
 """
 from __future__ import annotations
@@ -101,6 +116,32 @@ class InfinityStepper:
             int(np.prod(l.shape))
             for l in jax.tree_util.tree_leaves(self.resident_tpl)))
 
+        # -- shardings (multi-chip: dp-sharded layer vector) ---------------
+        from ...parallel import topology as topo
+        mesh = engine.mesh
+        self.dp = topo.dp_world_size(mesh)
+        # flat layer vector padded so it splits evenly into dp shards;
+        # both the vector and the batch ride the data-like axes
+        self.n_pad = -(-self.n_elems // self.dp) * self.dp
+        self._flat_shard = topo.batch_sharding(mesh)
+        self._batch_shard = topo.batch_sharding(mesh)
+        self._repl = topo.replicated(mesh)
+        # process-local span of the shard axis (multi-host: each host's
+        # stores cover only its ranks' partitions)
+        imap = self._flat_shard.devices_indices_map((self.n_pad,))
+        spans = sorted(
+            (0 if idx[0].start is None else int(idx[0].start),
+             self.n_pad if idx[0].stop is None else int(idx[0].stop))
+            for dev, idx in imap.items()
+            if dev.process_index == jax.process_index())
+        self._lo, self._hi = spans[0][0], spans[-1][1]
+        uniq = sorted(set(spans))
+        if any(a[1] != b[0] for a, b in zip(uniq, uniq[1:])):
+            raise NotImplementedError(
+                "ZeRO-Infinity needs this process's dp shards contiguous in "
+                f"the flat vector; got spans {spans}")
+        self.n_local = self._hi - self._lo
+
         # -- host stores ---------------------------------------------------
         from ..swap_tensor.slot_store import make_slot_store
         from ..swap_tensor.partitioned_optimizer_swapper import SlotOptimizer
@@ -112,11 +153,11 @@ class InfinityStepper:
                 block_size=aio_cfg.block_size,
                 num_threads=aio_cfg.thread_count)
         self.param_store = make_slot_store(
-            op.device.value, self.L, self.n_elems * 2,
+            op.device.value, self.L, self.n_local * 2,
             nvme_path=op.nvme_path, aio=shared_aio,
             buffer_count=max(3, op.buffer_count), name="params")
         self.opt = SlotOptimizer(
-            self.L, self.n_elems, device=oo.device.value,
+            self.L, self.n_local, device=oo.device.value,
             nvme_path=oo.nvme_path, aio=shared_aio,
             buffer_count=max(3, oo.buffer_count), lr=self.lr_default,
             betas=betas, eps=eps, weight_decay=wd, adamw_mode=adamw,
@@ -157,7 +198,8 @@ class InfinityStepper:
         disk_gb = (self.param_store.disk_bytes + self.opt.disk_bytes) / 2**30
         logger.info(
             f"ZeRO-Infinity: {self.total_params / 1e9:.2f}B params, "
-            f"{self.L} layers x {self.n_elems / 1e6:.1f}M elems; host "
+            f"{self.L} layers x {self.n_elems / 1e6:.1f}M elems, dp="
+            f"{self.dp} (local span {self.n_local / 1e6:.1f}M); host "
             f"{host_gb:.1f} GiB, nvme {disk_gb:.1f} GiB "
             f"(params={op.device.value}, optimizer={oo.device.value})")
 
@@ -170,11 +212,16 @@ class InfinityStepper:
                     "ZeRO-Infinity needs a scan-layer model exposing "
                     "init_superblock/init_resident (TransformerLM does); "
                     f"got {type(model).__name__}")
-        if len(list(engine.mesh.devices.flat)) != 1:
-            raise NotImplementedError(
-                "ZeRO-Infinity is the single-chip beyond-HBM path; on a "
-                "multi-chip mesh use ZeRO-3 sharding (remove offload_param) "
-                "— combining both is not built yet")
+        from ...parallel import topology as topo
+        mesh = engine.mesh
+        for axis in (topo.MODEL_AXIS, topo.PIPE_AXIS, topo.SEQUENCE_AXIS,
+                     topo.EXPERT_AXIS):
+            if mesh.shape.get(axis, 1) > 1:
+                raise NotImplementedError(
+                    f"ZeRO-Infinity composes with data-parallel sharding "
+                    f"only; mesh axis '{axis}' has size "
+                    f"{mesh.shape[axis]} — use a pure dp mesh under "
+                    f"offload_param, or drop offload for tp/pp/sp")
         if engine.fp16_enabled:
             raise NotImplementedError(
                 "ZeRO-Infinity requires bf16 (fp16 loss scaling is not "
@@ -209,7 +256,8 @@ class InfinityStepper:
         device→host fetch, which dominates startup on slow D2H links."""
         model = self.model
         with self.engine.mesh:
-            self.resident = jax.jit(model.init_resident)(rng)
+            self.resident = jax.jit(model.init_resident,
+                                    out_shardings=self._repl)(rng)
         if self.engine._config.zero_config.infinity_host_init:
             nrng = np.random.default_rng(
                 int(jax.random.randint(rng, (), 0, 2**31 - 1)))
@@ -224,11 +272,7 @@ class InfinityStepper:
                     else:          # biases 0 (norm scales fixed up below)
                         span[:] = 0.0
                 self._set_norm_scales_one(self._unflatten_host(flat))
-                self.opt.init_slot(i, flat)
-                buf = self.param_store.acquire(i)
-                buf[:self.n_elems * 2].view(np.uint16)[:] = (
-                    flat.astype(ml_dtypes.bfloat16).view(np.uint16))
-                self.param_store.release(i, dirty=True)
+                self._init_slot_from_full(i, flat)
         else:
             with self.engine.mesh:
                 def one_layer(k):
@@ -236,20 +280,32 @@ class InfinityStepper:
                         model.init_superblock(k))
                     flat = jnp.concatenate(
                         [l.reshape(-1).astype(jnp.float32) for l in leaves])
-                    return flat, flat.astype(jnp.bfloat16)
+                    return flat
 
                 init_fn = jax.jit(one_layer)
                 keys = model.superblock_keys(rng)
                 for i in range(self.L):
-                    f32, b16 = init_fn(keys[i])
-                    f32_h = np.asarray(f32)
-                    self.opt.init_slot(i, f32_h)
-                    buf = self.param_store.acquire(i)
-                    buf[:self.n_elems * 2].view(np.uint16)[:] = np.asarray(
-                        b16).view(np.uint16)
-                    self.param_store.release(i, dirty=True)
+                    # every process computes the (identical) full vector,
+                    # stores only its local span
+                    self._init_slot_from_full(i, np.asarray(init_fn(keys[i])))
         self.param_store.flush()
         self.opt.flush()
+
+    def _local_f32(self, flat_full: np.ndarray) -> np.ndarray:
+        """This process's span of the padded flat vector (pad tail zeros)."""
+        out = np.zeros(self.n_local, np.float32)
+        hi = min(self._hi, self.n_elems)
+        if hi > self._lo:
+            out[:hi - self._lo] = flat_full[self._lo:hi]
+        return out
+
+    def _init_slot_from_full(self, i: int, flat_full: np.ndarray) -> None:
+        loc = self._local_f32(flat_full)
+        self.opt.init_slot(i, loc)
+        buf = self.param_store.acquire(i)
+        buf[:self.n_local * 2].view(np.uint16)[:] = (
+            loc.astype(ml_dtypes.bfloat16).view(np.uint16))
+        self.param_store.release(i, dirty=True)
 
     def _host_init_stds(self) -> List[float]:
         """Per-leaf init stddev matching model init (models/transformer.py
@@ -304,6 +360,38 @@ class InfinityStepper:
                 still.append((slot, arr))
         self._pending_uploads = still
 
+    def _put_flat(self, host_bf16_local: np.ndarray) -> jax.Array:
+        """Upload the process-local span to the dp-sharded device vector.
+        Single-process: one sharded device_put (JAX slices per device).
+        Multi-host: each process contributes only its addressable shards."""
+        if jax.process_count() == 1:
+            return jax.device_put(host_bf16_local, self._flat_shard)
+        shards = []
+        imap = self._flat_shard.addressable_devices_indices_map(
+            (self.n_pad,))
+        for dev, idx in imap.items():
+            sl = idx[0]
+            lo = 0 if sl.start is None else int(sl.start)
+            hi = self.n_pad if sl.stop is None else int(sl.stop)
+            shards.append(jax.device_put(
+                host_bf16_local[lo - self._lo:hi - self._lo], dev))
+        return jax.make_array_from_single_device_arrays(
+            (self.n_pad,), self._flat_shard, shards)
+
+    def _fetch_flat(self, arr: jax.Array) -> np.ndarray:
+        """bf16 device vector → host, process-local span only (the D2H wire
+        carries each host's partition, reference partitioned_param_swapper
+        per-rank IO)."""
+        if jax.process_count() == 1:
+            return np.asarray(arr)
+        out = np.empty(self.n_local, ml_dtypes.bfloat16)
+        for sh in arr.addressable_shards:
+            sl = sh.index[0]
+            lo = 0 if sl.start is None else int(sl.start)
+            out[lo - self._lo:lo - self._lo + sh.data.shape[0]] = (
+                np.asarray(sh.data))
+        return out
+
     def _ensure_layer(self, i: int, keep) -> jax.Array:
         if i in self._dev:
             return self._dev[i]
@@ -312,8 +400,8 @@ class InfinityStepper:
                 del self._dev[k]
         self._sweep_uploads()
         buf = self.param_store.acquire(i)
-        host = buf[:self.n_elems * 2].view(ml_dtypes.bfloat16)
-        arr = jax.device_put(host)
+        host = buf[:self.n_local * 2].view(ml_dtypes.bfloat16)
+        arr = self._put_flat(host)
         self._pending_uploads.append((i, arr))  # pin held until transfer done
         self._dev[i] = arr
         return arr
@@ -425,17 +513,26 @@ class InfinityStepper:
                      for l in jax.tree_util.tree_leaves(summed))
             return summed, sq
 
+        # out_shardings pin the ZeRO contract: activations ride the batch
+        # axis, dflat is reduce-scattered back to dp shards (XLA emits the
+        # psum-fused scatter), resident grads and scalars replicate
         with self.engine.mesh:
             progs = dict(
-                embed_fwd=jax.jit(embed_fwd),
-                block_fwd=jax.jit(block_fwd),
-                head_vjp=jax.jit(head_vjp),
-                block_vjp=jax.jit(block_vjp),
-                embed_vjp=jax.jit(embed_vjp),
-                res_combine=jax.jit(res_combine),
+                embed_fwd=jax.jit(embed_fwd,
+                                  out_shardings=self._batch_shard),
+                block_fwd=jax.jit(block_fwd,
+                                  out_shardings=self._batch_shard),
+                head_vjp=jax.jit(head_vjp, out_shardings=(
+                    self._repl, self._repl, self._batch_shard)),
+                block_vjp=jax.jit(block_vjp, out_shardings=(
+                    self._flat_shard, self._batch_shard, self._repl)),
+                embed_vjp=jax.jit(embed_vjp, out_shardings=self._repl),
+                res_combine=jax.jit(res_combine, out_shardings=(
+                    self._repl, self._repl)),
                 eval_loss=jax.jit(
                     lambda res, xL, ids, labels, mask:
-                    head_loss(res, xL, ids, labels, mask)),
+                    head_loss(res, xL, ids, labels, mask),
+                    out_shardings=self._repl),
             )
         self._programs[key] = progs
         return progs
@@ -451,6 +548,11 @@ class InfinityStepper:
             if b % gas:
                 raise ValueError(f"batch {b} not divisible by gas {gas}")
             ids = ids.reshape(gas, b // gas, *ids.shape[1:])
+        if ids.shape[1] % self.dp:
+            raise ValueError(
+                f"micro-batch {ids.shape[1]} not divisible by the "
+                f"data-parallel width {self.dp} (Infinity shards the batch "
+                f"over the dp axis)")
         labels = batch.get("labels")
         mask = batch.get("loss_mask")
 
@@ -481,9 +583,12 @@ class InfinityStepper:
         """One microbatch forward+backward, streaming layer grads into
         ``on_layer_grad``. Returns (loss, resident_grad_tree_dev, sq_dev)."""
         zero_i = jnp.zeros((1, 1), jnp.int32)
-        ids_dev = jnp.asarray(ids)
-        labels_dev = jnp.asarray(labels) if labels is not None else zero_i
-        mask_dev = (jnp.asarray(mask, jnp.float32) if mask is not None
+        ids_dev = jax.device_put(np.asarray(ids), self._batch_shard)
+        labels_dev = (jax.device_put(np.asarray(labels), self._batch_shard)
+                      if labels is not None else zero_i)
+        mask_dev = (jax.device_put(np.asarray(mask, np.float32),
+                                   self._batch_shard)
+                    if mask is not None
                     else jnp.zeros((1, 1), jnp.float32))
         acts, xL = self._forward_stream(progs, ids_dev)
         loss, d_res_head, dy = progs["head_vjp"](
@@ -512,10 +617,10 @@ class InfinityStepper:
                     grad_scale: float) -> None:
         """Worker-thread task: D2H-complete grad → native Adam sweep →
         bf16 emit into the param store slot (stream mode)."""
-        g = np.asarray(dflat)           # bf16 (ml_dtypes) — wire format
+        g = self._fetch_flat(dflat)     # bf16 (ml_dtypes) — wire format
         self.opt.prefetch(i)
         pbuf = self.param_store.acquire(i)
-        out16 = pbuf[:self.n_elems * 2].view(np.uint16)
+        out16 = pbuf[:self.n_local * 2].view(np.uint16)
         self.opt.step_slot(i, g.view(np.uint16), lr=lr,
                            grad_scale=grad_scale, out_bf16=out16)
         self.param_store.release(i, dirty=True)
@@ -524,11 +629,11 @@ class InfinityStepper:
         """Worker-thread task: accumulate bf16 grads into the fp32 host
         store (collect mode)."""
         if self._grad_accum is None:
-            self._grad_accum = np.zeros((self.L, self.n_elems), np.float32)
-        g = np.asarray(dflat).view(np.uint16)
+            self._grad_accum = np.zeros((self.L, self.n_local), np.float32)
+        g = self._fetch_flat(dflat).view(np.uint16)
         if self._native is not None:
             from ...ops.adam.cpu_adam import _C_F32, _C_U16, _ptr
-            self._native.ds_accum_g16(self.n_elems,
+            self._native.ds_accum_g16(self.n_local,
                                       _ptr(self._grad_accum[i], _C_F32),
                                       _ptr(np.ascontiguousarray(g), _C_U16))
         else:
@@ -542,7 +647,7 @@ class InfinityStepper:
             if i + 1 < self.L:
                 self.opt.prefetch(i + 1)
             pbuf = self.param_store.acquire(i)
-            out16 = pbuf[:self.n_elems * 2].view(np.uint16)
+            out16 = pbuf[:self.n_local * 2].view(np.uint16)
             self.opt.step_slot(i, self._grad_accum[i], lr=lr,
                                grad_scale=grad_scale, out_bf16=out16)
             self.param_store.release(i, dirty=True)
@@ -560,7 +665,7 @@ class InfinityStepper:
                 g = jax.tree_util.tree_map(lambda x: x / scale, g)
                 return opt.apply(g, st, res, lr_)
             with self.engine.mesh:
-                self._res_apply = jax.jit(apply)
+                self._res_apply = jax.jit(apply, out_shardings=self._repl)
         self.resident, self.res_state = self._res_apply(
             self.resident, self.res_state, grads_dev,
             jnp.asarray(lr, jnp.float32),
@@ -588,10 +693,11 @@ class InfinityStepper:
         if getattr(self, "_res_add", None) is None:
             with self.engine.mesh:
                 self._res_add = jax.jit(lambda a, b: jax.tree_util.tree_map(
-                    jnp.add, a, b))
+                    jnp.add, a, b), out_shardings=self._repl)
                 self._res_sq = jax.jit(lambda t: sum(
                     jnp.sum(jnp.square(l))
-                    for l in jax.tree_util.tree_leaves(t)))
+                    for l in jax.tree_util.tree_leaves(t)),
+                    out_shardings=self._repl)
         for j in range(gas):
             if stream:
                 def on_grad(i, dflat):
@@ -620,10 +726,18 @@ class InfinityStepper:
             # exact norm of the ACCUMULATED grads (clipping must see the
             # true norm — reference runtime/utils.py:325 clip_grad_norm_)
             sq = float(self._res_sq(res_acc))
+            block_sq = 0.0
             if self._grad_accum is not None:
                 for i in range(self.L):
                     row = self._grad_accum[i]
-                    sq += float(np.dot(row, row))
+                    block_sq += float(np.dot(row, row))
+            if jax.process_count() > 1:
+                # each host holds a disjoint span of the block grads —
+                # sum the partial squared norms across processes
+                from jax.experimental import multihost_utils
+                block_sq = float(np.sum(multihost_utils.process_allgather(
+                    np.float32(block_sq))))
+            sq += block_sq
             gnorm = math.sqrt(sq) / gas
             if self.clip > 0.0 and np.isfinite(gnorm) and gnorm > self.clip:
                 grad_scale *= gnorm / self.clip
@@ -650,21 +764,35 @@ class InfinityStepper:
         mask = batch.get("loss_mask")
         progs = self._build_programs(labels is not None, mask is not None)
         self._dev.clear()
-        ids_dev = jnp.asarray(ids)
+        if ids.shape[0] % self.dp:
+            raise ValueError(
+                f"eval batch {ids.shape[0]} not divisible by dp {self.dp}")
+        ids_dev = jax.device_put(ids, self._batch_shard)
         zero_i = jnp.zeros((1, 1), jnp.int32)
         _, xL = self._forward_stream(progs, ids_dev, stash=False)
         out = float(progs["eval_loss"](
             self.resident, xL, ids_dev,
-            jnp.asarray(labels) if labels is not None else zero_i,
-            jnp.asarray(mask, jnp.float32) if mask is not None
+            jax.device_put(np.asarray(labels), self._batch_shard)
+            if labels is not None else zero_i,
+            jax.device_put(np.asarray(mask, np.float32), self._batch_shard)
+            if mask is not None
             else jnp.zeros((1, 1), jnp.float32)))
         self._sweep_uploads(block=True)
         return out
 
+    def _require_single_process(self, what: str) -> None:
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                f"{what} on a multi-host pod needs a cross-process gather "
+                f"of the partitioned host slots — run it from a "
+                f"single-process restore, or use per-host save dirs")
+
     def gather_params(self):
         """Full (unstacked→stacked) param tree as host numpy — the
         zero_to_fp32 equivalent for tests/export. Masters (fp32)."""
-        blocks_flat = np.stack([self.opt.master(i) for i in range(self.L)])
+        self._require_single_process("gather_params")
+        blocks_flat = np.stack([self.opt.master(i)[:self.n_elems]
+                                for i in range(self.L)])
         leaves = []
         for o, s, sh in zip(self._offsets, self._sizes, self._shapes):
             leaves.append(blocks_flat[:, o:o + s].reshape((self.L,) + sh))
@@ -683,10 +811,15 @@ class InfinityStepper:
         (runtime/checkpoint_engine/engine.py) for infinity-mode saves."""
         import json
         import os
+        self._require_single_process("Infinity checkpoint save")
         os.makedirs(path, exist_ok=True)
         for i in range(self.L):
             p, m, v = self.opt.state(i)
-            np.savez(os.path.join(path, f"slot_{i:05d}.npz"), p=p, m=m, v=v)
+            # logical (unpadded) vectors — checkpoints are mesh-independent,
+            # a D=1 save restores onto a D=8 mesh and vice versa
+            n = self.n_elems
+            np.savez(os.path.join(path, f"slot_{i:05d}.npz"),
+                     p=p[:n], m=m[:n], v=v[:n])
         res = self._resident_state_host()
         np.savez(os.path.join(path, "resident.npz"),
                  **{f"{k}_{j}": a for k, arrs in res.items()
@@ -733,13 +866,10 @@ class InfinityStepper:
 
     def _load_resident_state(self, res: Dict[str, List[np.ndarray]],
                              step_count: int) -> None:
-        # single-chip path (validated at __init__), so plain device_put
-        # places these correctly; a future multi-chip infinity would need
-        # the init-time shardings here
         def put(leaves):
             return jax.device_put(jax.tree_util.tree_unflatten(
                 self._res_treedef,
-                [np.asarray(a, np.float32) for a in leaves]))
+                [np.asarray(a, np.float32) for a in leaves]), self._repl)
         self.resident = put(res["master"])
         self.res_state = {"step": jnp.asarray(int(step_count), jnp.int32),
                           "m": put(res["m"]), "v": put(res["v"])}
@@ -754,15 +884,15 @@ class InfinityStepper:
             raise ValueError(
                 f"checkpoint layout (L={meta['L']}, n={meta['n_elems']}) "
                 f"does not match this model (L={self.L}, n={self.n_elems})")
-        zeros = np.zeros(self.n_elems, np.float32)
+        zl = np.zeros(self.n_local, np.float32)
         for i in range(self.L):
             with np.load(os.path.join(path, f"slot_{i:05d}.npz")) as z:
-                p = z["p"]
-                m = z["m"] if load_optimizer_states else zeros
-                v = z["v"] if load_optimizer_states else zeros
+                p = self._local_f32(z["p"])
+                m = self._local_f32(z["m"]) if load_optimizer_states else zl
+                v = self._local_f32(z["v"]) if load_optimizer_states else zl
                 self.opt.load_state(i, p, m, v)
                 buf = self.param_store.acquire(i)
-                buf[:self.n_elems * 2].view(np.uint16)[:] = (
+                buf[:self.n_local * 2].view(np.uint16)[:] = (
                     p.astype(ml_dtypes.bfloat16).view(np.uint16))
                 self.param_store.release(i, dirty=True)
         with np.load(os.path.join(path, "resident.npz")) as z:
@@ -781,9 +911,12 @@ class InfinityStepper:
         self.opt.flush()
 
     def state_dict(self) -> Dict:
+        self._require_single_process("Infinity state_dict")
+        n = self.n_elems
         return {
             "step_count": self.opt.step_count,
-            "slots": [self.opt.state(i) for i in range(self.L)],
+            "slots": [tuple(a[:n] for a in self.opt.state(i))
+                      for i in range(self.L)],
             "resident": self._resident_state_host(),
             "res_step_count": self.res_step_count,
         }
@@ -791,9 +924,10 @@ class InfinityStepper:
     def load_state_dict(self, sd: Dict) -> None:
         self.opt.step_count = int(sd["step_count"])
         for i, (p, m, v) in enumerate(sd["slots"]):
+            p, m, v = (self._local_f32(np.asarray(a)) for a in (p, m, v))
             self.opt.load_state(i, p, m, v)
             buf = self.param_store.acquire(i)
-            buf[:self.n_elems * 2].view(np.uint16)[:] = (
+            buf[:self.n_local * 2].view(np.uint16)[:] = (
                 p.astype(ml_dtypes.bfloat16).view(np.uint16))
             self.param_store.release(i, dirty=True)
         self._load_resident_state(sd["resident"], sd["res_step_count"])
