@@ -28,9 +28,14 @@ class MoEInferenceConfig(ConfigModel):
 
 class QuantConfig(ConfigModel):
     """Weight quantization for serving (reference quant block: qkv/mlp int8).
-    ``bits`` 0 disables."""
+    ``bits`` 0 disables. ``quantize_embeddings`` widens the scope to the
+    embedding tables / lm_head — the reference GroupQuantizer
+    (`module_inject/replace_module.py:150`) restricts itself to the
+    attention/MLP projections, and int8 embeddings carry a
+    disproportionate quality cost, so the default matches that scope."""
     enabled: bool = False
     bits: int = 8
+    quantize_embeddings: bool = False
 
 
 class DeepSpeedInferenceConfig(ConfigModel):

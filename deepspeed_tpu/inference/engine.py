@@ -136,9 +136,20 @@ class InferenceEngine:
         bits = self.config.quant.bits or 8
         tmpl = jax.device_get(jax.tree_util.tree_map(
             lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), self.params))
-        self._qflags = jax.tree_util.tree_map(
-            lambda l: (len(l.shape) >= 2
-                       and jnp.issubdtype(l.dtype, jnp.floating)), tmpl)
+        # Scope: attention/MLP matrices only by default (reference
+        # GroupQuantizer scope) — embedding tables, the tied/untied lm_head
+        # and the MLM head keep full precision unless
+        # quant.quantize_embeddings widens it.
+        skip_roots = (() if self.config.quant.quantize_embeddings
+                      else ("embed", "pos_embed", "type_embed", "lm_head",
+                            "mlm_head"))
+
+        def flag(path, l):
+            root = str(path[0].key) if path else ""
+            return (len(l.shape) >= 2
+                    and jnp.issubdtype(l.dtype, jnp.floating)
+                    and root not in skip_roots)
+        self._qflags = jax.tree_util.tree_map_with_path(flag, tmpl)
         self._qshapes = jax.tree_util.tree_map(lambda l: tuple(l.shape),
                                                tmpl)
 

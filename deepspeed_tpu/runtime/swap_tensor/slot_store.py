@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -105,6 +106,17 @@ class NvmeSlotStore(SlotStore):
     buffer ring over the native aio handle (reference
     `partitioned_param_swapper.py` swap_in/swap_out + inflight tracking)."""
 
+    #: seconds _free_buf blocks for a concurrent release before declaring
+    #: an acquire/release imbalance (instance-settable for tests)
+    PIN_WAIT_TIMEOUT = 60.0
+
+    #: optional callable the store invokes (lock held, re-entrant) when no
+    #: buffer is free — lets the OWNER of outstanding pins release the ones
+    #: whose async consumer (e.g. an H2D transfer) has finished. Without
+    #: it, a thread that holds all pins itself would wait on its own
+    #: release path and time out.
+    reclaim = None
+
     def __init__(self, n_slots: int, slot_nbytes: int, path: str,
                  aio: Optional[AsyncIOHandle] = None, buffer_count: int = 4,
                  name: str = "slots"):
@@ -125,6 +137,7 @@ class NvmeSlotStore(SlotStore):
         # the stream-mode train loop touches the store from the main thread
         # (param uploads) and the optimizer worker concurrently
         self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
         # preallocate the file so O_DIRECT offsets always exist
         total = self.stride * n_slots
         with open(path, "ab") as f:
@@ -143,21 +156,38 @@ class NvmeSlotStore(SlotStore):
 
     def _free_buf(self) -> int:
         """Next unpinned ring buffer, evicting its previous slot (after any
-        pending IO on it has completed)."""
-        for _ in range(len(self._bufs)):
-            b = self._clock % len(self._bufs)
-            self._clock += 1
-            if self._buf_pins[b] > 0:
-                continue
-            self._wait_buf(b)
-            old = self._buf_slot[b]
-            if old is not None and self._slot_buf.get(old) == b:
-                del self._slot_buf[old]
-            self._buf_slot[b] = None
-            return b
-        raise RuntimeError(
-            f"all {len(self._bufs)} pinned buffers are acquired — raise "
-            f"buffer_count (acquire/release imbalance otherwise)")
+        pending IO on it has completed). When every buffer is pinned
+        (main thread holding upload pins while the optimizer worker holds
+        its own), block until a concurrent ``release`` frees one rather
+        than aborting the step; only a full timeout — a genuine
+        acquire/release imbalance — raises."""
+        deadline = time.monotonic() + self.PIN_WAIT_TIMEOUT
+        while True:
+            for _ in range(len(self._bufs)):
+                b = self._clock % len(self._bufs)
+                self._clock += 1
+                if self._buf_pins[b] > 0:
+                    continue
+                self._wait_buf(b)
+                old = self._buf_slot[b]
+                if old is not None and self._slot_buf.get(old) == b:
+                    del self._slot_buf[old]
+                self._buf_slot[b] = None
+                return b
+            if self.reclaim is not None:
+                # release pins whose async consumer has completed — they
+                # belong to THIS thread, so cond.wait could never see them
+                self.reclaim()
+                continue_scan = any(p == 0 for p in self._buf_pins)
+                if continue_scan:
+                    continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not self._cond.wait(min(remaining, 1.0)):
+                if time.monotonic() >= deadline:
+                    raise RuntimeError(
+                        f"all {len(self._bufs)} pinned buffers stayed "
+                        f"acquired for {self.PIN_WAIT_TIMEOUT:.0f}s — raise "
+                        f"buffer_count (acquire/release imbalance otherwise)")
 
     # -- API --------------------------------------------------------------
     def prefetch(self, slot: int) -> None:
@@ -165,6 +195,11 @@ class NvmeSlotStore(SlotStore):
             if slot in self._slot_buf:
                 return
             b = self._free_buf()
+            if slot in self._slot_buf:
+                # _free_buf's cond.wait releases the lock — another thread
+                # may have mapped this slot meanwhile; keep its mapping
+                # (buffer b stays unpinned/unmapped for the next scan)
+                return
             self._buf_op[b] = self.aio.pread(
                 self._bufs[b].array, self.path, slot * self.stride)
             self._buf_slot[b] = slot
@@ -186,6 +221,8 @@ class NvmeSlotStore(SlotStore):
                 return
             if self._buf_pins[b] > 0:
                 self._buf_pins[b] -= 1
+                if self._buf_pins[b] == 0:
+                    self._cond.notify_all()
             if dirty:
                 self._buf_op[b] = self.aio.pwrite(
                     self._bufs[b].array, self.path, slot * self.stride)

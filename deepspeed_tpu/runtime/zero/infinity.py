@@ -155,7 +155,21 @@ class InfinityStepper:
         self.param_store = make_slot_store(
             op.device.value, self.L, self.n_local * 2,
             nvme_path=op.nvme_path, aio=shared_aio,
-            buffer_count=max(3, op.buffer_count), name="params")
+            buffer_count=max(4, op.buffer_count), name="params")
+        # upload pins are held by the STREAMING thread until each async H2D
+        # transfer completes — give the store a way to reclaim them when
+        # its ring runs dry (otherwise that thread would block waiting on
+        # its own release path). Gated to the streaming thread: the
+        # optimizer worker must NOT run the sweep (it would race
+        # _pending_uploads and invert the store-lock/upload order) — it
+        # falls through to the store's cond.wait until the streaming
+        # thread sweeps.
+        self._stream_thread = threading.current_thread()
+
+        def _reclaim():
+            if threading.current_thread() is self._stream_thread:
+                self._sweep_uploads(block=True)
+        self.param_store.reclaim = _reclaim
         self.opt = SlotOptimizer(
             self.L, self.n_local, device=oo.device.value,
             nvme_path=oo.nvme_path, aio=shared_aio,
@@ -430,13 +444,14 @@ class InfinityStepper:
                 lambda p: p.astype(c.dtype)
                 if jnp.issubdtype(p.dtype, jnp.floating) else p, res)
 
-        def embed_fwd(res, ids):
-            res = cast_res(res)
-            x = Lx.embedding_apply(res["embed"], ids, c.dtype)
-            if c.pos_embedding == "learned":
-                pos = jnp.arange(ids.shape[1])[None, :]
-                x = x + Lx.embedding_apply(res["pos_embed"], pos, c.dtype)
-            return x
+        def embed_fwd(res, ids, tt):
+            # Delegate to the model's shared embedding path so the offload
+            # forward math matches the in-HBM path exactly — including the
+            # token-type add (BERT) and embedding layernorm (BLOOM) that
+            # init_resident stores (models/transformer.py _embed_tokens).
+            return model._embed_tokens(
+                cast_res(res), ids,
+                token_type_ids=(tt if c.token_type_vocab else None))
 
         def block_fwd(flat, x):
             lp = self._unflatten(flat)
@@ -501,8 +516,8 @@ class InfinityStepper:
             sq = jnp.sum(jnp.square(dflat.astype(jnp.float32)))
             return dflat, dx, sq
 
-        def embed_vjp(res, ids, dx):
-            _, vjp = jax.vjp(lambda r: embed_fwd(r, ids), res)
+        def embed_vjp(res, ids, tt, dx):
+            _, vjp = jax.vjp(lambda r: embed_fwd(r, ids, tt), res)
             return vjp(dx)[0]
 
         def res_combine(a, b):
@@ -555,6 +570,7 @@ class InfinityStepper:
                 f"over the dp axis)")
         labels = batch.get("labels")
         mask = batch.get("loss_mask")
+        tt = batch.get("token_type_ids")
 
         def reshape_like(a):
             a = np.asarray(a)
@@ -562,12 +578,13 @@ class InfinityStepper:
                     if a.ndim == 2 else a)
         return (ids,
                 reshape_like(labels) if labels is not None else None,
-                reshape_like(mask) if mask is not None else None)
+                reshape_like(mask) if mask is not None else None,
+                reshape_like(tt) if tt is not None else None)
 
-    def _forward_stream(self, progs, ids_dev, stash: bool = True):
+    def _forward_stream(self, progs, ids_dev, tt_dev, stash: bool = True):
         """Streamed forward → (activation stash | None, final hidden)."""
         L = self.L
-        x = progs["embed_fwd"](self.resident, ids_dev)
+        x = progs["embed_fwd"](self.resident, ids_dev, tt_dev)
         acts: List[Any] = [None] * L if stash else None
         self._ensure_layer(0, {0})
         for i in range(L):
@@ -578,7 +595,17 @@ class InfinityStepper:
             x = progs["block_fwd"](self._dev[i], x)
         return acts, x
 
-    def _micro_fwd_bwd(self, progs, ids, labels, mask,
+    def _tt_dev(self, tt, ids):
+        """Token-type ids on device. Models without a type vocab get a
+        (1,1) dummy (the jitted program drops the unused arg); models with
+        one default to all-zero types, matching ``_embed_tokens``."""
+        if not self.model.config.token_type_vocab:
+            return jnp.zeros((1, 1), jnp.int32)
+        if tt is None:
+            tt = np.zeros_like(np.asarray(ids))
+        return jax.device_put(np.asarray(tt), self._batch_shard)
+
+    def _micro_fwd_bwd(self, progs, ids, labels, mask, tt,
                        on_layer_grad: Callable[[int, Any], None]):
         """One microbatch forward+backward, streaming layer grads into
         ``on_layer_grad``. Returns (loss, resident_grad_tree_dev, sq_dev)."""
@@ -590,7 +617,8 @@ class InfinityStepper:
                                    self._batch_shard)
                     if mask is not None
                     else jnp.zeros((1, 1), jnp.float32))
-        acts, xL = self._forward_stream(progs, ids_dev)
+        tt_dev = self._tt_dev(tt, ids)
+        acts, xL = self._forward_stream(progs, ids_dev, tt_dev)
         loss, d_res_head, dy = progs["head_vjp"](
             self.resident, xL, ids_dev, labels_dev, mask_dev)
         sqs = []
@@ -605,7 +633,7 @@ class InfinityStepper:
                 pass
             sqs.append(sq)
             on_layer_grad(i, dflat)
-        d_res_embed = progs["embed_vjp"](self.resident, ids_dev, dy)
+        d_res_embed = progs["embed_vjp"](self.resident, ids_dev, tt_dev, dy)
         d_res, res_sq = progs["res_combine"](d_res_head, d_res_embed)
         total_sq = res_sq + sum(sqs)
         return loss, d_res, total_sq
@@ -676,8 +704,9 @@ class InfinityStepper:
     # ------------------------------------------------------------------
     def train_step(self, batch) -> Dict:
         t0 = time.perf_counter()
+        self._stream_thread = threading.current_thread()
         engine = self.engine
-        ids, labels, mask = self._prep_batch(batch)
+        ids, labels, mask, tt = self._prep_batch(batch)
         progs = self._build_programs(labels is not None, mask is not None)
         step_i = int(engine.state["step"])
         lr = float(engine.lr_schedule(jnp.asarray(step_i)))
@@ -710,7 +739,8 @@ class InfinityStepper:
             loss, d_res, sq = self._micro_fwd_bwd(
                 progs, ids[j],
                 labels[j] if labels is not None else None,
-                mask[j] if mask is not None else None, on_grad)
+                mask[j] if mask is not None else None,
+                tt[j] if tt is not None else None, on_grad)
             loss_total += float(loss)
             sq_total += float(sq)
             res_acc = d_res if res_acc is None else self._res_add(res_acc,
@@ -763,13 +793,15 @@ class InfinityStepper:
         labels = batch.get("labels")
         mask = batch.get("loss_mask")
         progs = self._build_programs(labels is not None, mask is not None)
+        self._stream_thread = threading.current_thread()
         self._dev.clear()
         if ids.shape[0] % self.dp:
             raise ValueError(
                 f"eval batch {ids.shape[0]} not divisible by dp {self.dp}")
         ids_dev = jax.device_put(ids, self._batch_shard)
         zero_i = jnp.zeros((1, 1), jnp.int32)
-        _, xL = self._forward_stream(progs, ids_dev, stash=False)
+        tt_dev = self._tt_dev(batch.get("token_type_ids"), ids)
+        _, xL = self._forward_stream(progs, ids_dev, tt_dev, stash=False)
         out = float(progs["eval_loss"](
             self.resident, xL, ids_dev,
             jax.device_put(np.asarray(labels), self._batch_shard)

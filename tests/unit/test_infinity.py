@@ -149,12 +149,19 @@ class TestSlotStore:
     def test_nvme_pinning_guard(self, tmp_path):
         from deepspeed_tpu.runtime.swap_tensor import NvmeSlotStore
         st = NvmeSlotStore(5, 100, str(tmp_path / "p.swp"), buffer_count=2)
+        st.PIN_WAIT_TIMEOUT = 0.3
         st.acquire(0)
         st.acquire(1)
         with pytest.raises(RuntimeError):
-            st.acquire(2)   # both buffers pinned
+            st.acquire(2)   # both buffers pinned, nobody will release
         st.release(0)
         st.acquire(2)       # now fine
+        # a pinned-out store WAITS for a concurrent release instead of
+        # aborting the step (ADVICE r3: stream-mode transfer lag)
+        st.PIN_WAIT_TIMEOUT = 10.0
+        import threading as _t
+        _t.Timer(0.1, lambda: st.release(1)).start()
+        st.acquire(3)       # blocks until the timer releases slot 1
         st.close()
 
 
@@ -265,6 +272,42 @@ class TestInfinityEngine:
             r1 = base.train_step({"input_ids": ids})
             r2 = inf.train_step({"input_ids": ids})
             assert abs(float(r1["loss"]) - float(r2["loss"])) < 5e-3
+
+    @pytest.mark.parametrize("variant", ["bloom_ln_embed", "bert_types"])
+    def test_embed_variants_match_base(self, variant):
+        """ADVICE r3 (medium): embed_layernorm (BLOOM) and token-type
+        embeddings (BERT) must produce the SAME forward math under offload
+        as the in-HBM engine — embed_fwd now delegates to the model's
+        _embed_tokens instead of re-implementing a subset of it."""
+        over = (dict(embed_layernorm=True) if variant == "bloom_ln_embed"
+                else dict(token_type_vocab=2))
+        mk = lambda: TransformerLM(TransformerConfig(**{**TINY, **over}))
+        rng = jax.random.PRNGKey(0)
+        ids = ids_batch()
+        base = DeepSpeedEngine(mk(), config=engine_cfg(), rng=rng,
+                               mesh=single_mesh())
+        inf = DeepSpeedEngine(mk(), config=engine_cfg(zero=infinity_zero()),
+                              rng=rng, mesh=single_mesh())
+        for _ in range(3):
+            r1 = base.train_step({"input_ids": ids})
+            r2 = inf.train_step({"input_ids": ids})
+            assert abs(float(r1["loss"]) - float(r2["loss"])) < 5e-3
+
+    def test_token_type_ids_change_the_loss(self):
+        """Explicit token_type_ids must reach the embedding under offload
+        (not silently fall back to all-zero types)."""
+        over = dict(token_type_vocab=2)
+        mk = lambda: TransformerLM(TransformerConfig(**{**TINY, **over}))
+        ids = ids_batch()
+        tt = np.ones_like(ids)
+        inf = DeepSpeedEngine(mk(), config=engine_cfg(zero=infinity_zero()),
+                              rng=jax.random.PRNGKey(0), mesh=single_mesh())
+        l0 = inf.eval_loss({"input_ids": ids})
+        l1 = inf.eval_loss({"input_ids": ids, "token_type_ids": tt})
+        assert abs(l0 - l1) > 1e-6
+        # and the train path accepts the key
+        m = inf.train_step({"input_ids": ids, "token_type_ids": tt})
+        assert np.isfinite(m["loss"])
 
     def test_eval_loss_and_convergence(self):
         rng = jax.random.PRNGKey(0)
