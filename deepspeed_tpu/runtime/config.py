@@ -78,6 +78,10 @@ class DeepSpeedZeroOffloadOptimizerConfig(ConfigModel):
     pipeline_read: bool = False
     pipeline_write: bool = False
     fast_init: bool = False
+    # host optimizer-sweep parallelism; 0 = one worker per host core
+    # (capped at 8) — the reference's AVX sweep is single-threaded per
+    # sub-group but a TPU-VM host has dozens of cores to put behind it
+    worker_count: int = 0
 
     @property
     def pipeline(self) -> bool:

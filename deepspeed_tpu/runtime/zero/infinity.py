@@ -29,12 +29,21 @@ activations; shrink the stash with remat or micro-batching). Host tiers:
   offload_param.device:      cpu (DRAM byte store) | nvme (file + aio)
   offload_optimizer.device:  cpu | nvme  (master|m|v slots, SlotOptimizer)
 
-Two step modes:
-  stream  — gas==1, no grad clipping: each layer's optimizer update runs
-            during the backward of deeper^H earlier layers (full overlap).
-  collect — gradient accumulation and/or clipping: grads accumulate into a
-            host fp32 store; one pipelined optimizer sweep at the boundary
-            (the reference's pattern for the same configs).
+Step modes (all overlap the host work with device compute via a pool of
+per-layer-ordered workers, one per host core up to 8):
+  pure stream   — gas==1, no clipping: each layer's Adam update runs inside
+                  the backward (no host grad accumulator at all).
+  streamed gas  — gas>1, no clipping: microbatches 0..gas-2 accumulate into
+                  a host fp32 store; during the LAST microbatch each
+                  layer's update fires as soon as its accumulation
+                  completes — the sweep still hides inside the backward.
+  clip-gated    — clipping on (any gas): accumulate + record each layer's
+                  exact accumulated ||g||² as it completes; the global norm
+                  is ready the moment the last layer's grad lands, then the
+                  sweep runs parallel across the worker pool (the update
+                  must see the true norm — reference runtime/utils.py:325
+                  clip_grad_norm_ — so it cannot fire earlier without
+                  changing the math).
 
 Multi-chip composition (ZeRO-3 x Infinity): on a data-parallel mesh the
 flat layer vector is padded to a multiple of the dp width and sharded
@@ -56,6 +65,7 @@ loss scaling), dense blocks (no MoE), Adam/AdamW.
 from __future__ import annotations
 
 import math
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -201,8 +211,21 @@ class InfinityStepper:
         self._programs: Dict = {}
         self._dev: Dict[int, jax.Array] = {}     # slot -> device bf16 vector
         self._pending_uploads: List[Tuple[int, jax.Array]] = []
-        self._worker = ThreadPoolExecutor(max_workers=1,
-                                          thread_name_prefix="infinity-opt")
+        # Host optimizer parallelism: one single-thread executor per worker,
+        # layer i dispatched to worker i % N — per-layer ordering (accum of
+        # microbatch j before j+1) is preserved while distinct layers sweep
+        # on distinct cores (the native Adam + numpy accum release the GIL).
+        nw = int(getattr(oo, "worker_count", 0) or 0)
+        if nw <= 0:
+            nw = min(os.cpu_count() or 1, 8)
+        if "nvme" in (op.device.value, oo.device.value):
+            # each concurrent sweep task pins one param-ring AND one
+            # opt-ring buffer; bound concurrency below the smaller ring so
+            # two tasks can never exhaust both rings against each other
+            nw = min(nw, 2)
+        self._workers = [ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"infinity-opt{k}")
+            for k in range(nw)]
         try:
             from ...ops.adam.cpu_adam import _lib as adam_lib
             self._native = adam_lib()    # probed once; None → numpy paths
@@ -653,11 +676,16 @@ class InfinityStepper:
                            grad_scale=grad_scale, out_bf16=out16)
         self.param_store.release(i, dirty=True)
 
+    def _submit(self, i: int, fn, *args):
+        """Dispatch a layer task to its pinned worker (i % N) — preserves
+        per-layer ordering, parallelizes across layers."""
+        return self._workers[i % len(self._workers)].submit(fn, i, *args)
+
     def _accum_layer(self, i: int, dflat) -> None:
         """Worker-thread task: accumulate bf16 grads into the fp32 host
-        store (collect mode)."""
-        if self._grad_accum is None:
-            self._grad_accum = np.zeros((self.L, self.n_local), np.float32)
+        store (collect mode). ``_grad_accum`` is allocated by the main
+        thread before any submission (lazy alloc here would race across
+        workers)."""
         g = self._fetch_flat(dflat).view(np.uint16)
         if self._native is not None:
             from ...ops.adam.cpu_adam import _C_F32, _C_U16, _ptr
@@ -668,18 +696,30 @@ class InfinityStepper:
             self._grad_accum[i] += g.view(ml_dtypes.bfloat16).astype(
                 np.float32)
 
-    def _sweep_collected(self, lr: float, grad_scale: float) -> None:
-        """Pipelined optimizer sweep over all slots (collect mode):
-        prefetch slot i+1's state while the native step runs slot i."""
-        for i in range(self.L):
-            if i + 1 < self.L:
-                self.opt.prefetch(i + 1)
-            pbuf = self.param_store.acquire(i)
-            out16 = pbuf[:self.n_local * 2].view(np.uint16)
-            self.opt.step_slot(i, self._grad_accum[i], lr=lr,
-                               grad_scale=grad_scale, out_bf16=out16)
-            self.param_store.release(i, dirty=True)
-            self._grad_accum[i] = 0.0
+    def _apply_layer_from_accum(self, i: int, lr: float,
+                                grad_scale: float) -> None:
+        """Worker-thread task: Adam over the accumulated fp32 grad row →
+        bf16 emit into the param store slot; zero the row for next step."""
+        self.opt.prefetch(i)
+        pbuf = self.param_store.acquire(i)
+        out16 = pbuf[:self.n_local * 2].view(np.uint16)
+        self.opt.step_slot(i, self._grad_accum[i], lr=lr,
+                           grad_scale=grad_scale, out_bf16=out16)
+        self.param_store.release(i, dirty=True)
+        self._grad_accum[i] = 0.0
+
+    def _finish_layer(self, i: int, dflat, lr: float,
+                      apply_scale: Optional[float]) -> None:
+        """Worker-thread task for the LAST microbatch of a layer:
+        accumulate, record the layer's exact accumulated ||g||², and — when
+        no clipping gates the update (``apply_scale`` set) — run the Adam
+        sweep for this layer immediately, overlapped with the backward of
+        the layers below it (streamed update under gradient accumulation)."""
+        self._accum_layer(i, dflat)
+        row = self._grad_accum[i]
+        self._layer_sq[i] = float(np.dot(row, row))
+        if apply_scale is not None:
+            self._apply_layer_from_accum(i, lr, apply_scale)
 
     def _step_resident(self, grads_dev, lr: float,
                        grad_scale: float) -> None:
@@ -711,7 +751,9 @@ class InfinityStepper:
         step_i = int(engine.state["step"])
         lr = float(engine.lr_schedule(jnp.asarray(step_i)))
         gas = self.gas
-        stream = (gas == 1 and self.clip == 0.0)
+        # pure stream: grads are final on arrival, no norm gate — the Adam
+        # sweep rides inside the backward with no accumulator at all
+        pure_stream = (gas == 1 and self.clip == 0.0)
         self.opt.begin_step()
 
         futures = []
@@ -719,6 +761,9 @@ class InfinityStepper:
         sq_total = 0.0
         res_acc = None
         self._dev.clear()
+        if not pure_stream and self._grad_accum is None:
+            self._grad_accum = np.zeros((self.L, self.n_local), np.float32)
+        self._layer_sq = np.zeros(self.L, np.float64)
         if getattr(self, "_res_add", None) is None:
             with self.engine.mesh:
                 self._res_add = jax.jit(lambda a, b: jax.tree_util.tree_map(
@@ -728,14 +773,24 @@ class InfinityStepper:
                     for l in jax.tree_util.tree_leaves(t)),
                     out_shardings=self._repl)
         for j in range(gas):
-            if stream:
+            last = (j == gas - 1)
+            if pure_stream:
                 def on_grad(i, dflat):
-                    futures.append(self._worker.submit(
-                        self._step_layer, i, dflat, lr, 1.0))
+                    futures.append(self._submit(
+                        i, self._step_layer, dflat, lr, 1.0))
+            elif last:
+                # streamed finish: clip==0 applies Adam per layer as its
+                # accumulated grad completes, overlapped with the ongoing
+                # backward; clip>0 only records the exact per-layer ||g||²
+                # (the update must wait for the global norm)
+                apply_scale = float(gas) if self.clip == 0.0 else None
+
+                def on_grad(i, dflat, s=apply_scale):
+                    futures.append(self._submit(
+                        i, self._finish_layer, dflat, lr, s))
             else:
                 def on_grad(i, dflat):
-                    futures.append(self._worker.submit(
-                        self._accum_layer, i, dflat))
+                    futures.append(self._submit(i, self._accum_layer, dflat))
             loss, d_res, sq = self._micro_fwd_bwd(
                 progs, ids[j],
                 labels[j] if labels is not None else None,
@@ -749,18 +804,16 @@ class InfinityStepper:
             f.result()   # surface worker exceptions, join the sweep
 
         grad_scale = float(gas)
-        if stream:
+        if pure_stream:
             # gas==1: Σ per-layer ||g||² IS the exact squared norm
             gnorm = math.sqrt(sq_total)
         else:
             # exact norm of the ACCUMULATED grads (clipping must see the
-            # true norm — reference runtime/utils.py:325 clip_grad_norm_)
+            # true norm — reference runtime/utils.py:325 clip_grad_norm_);
+            # per-layer terms were recorded by _finish_layer as each
+            # layer's accumulation completed
             sq = float(self._res_sq(res_acc))
-            block_sq = 0.0
-            if self._grad_accum is not None:
-                for i in range(self.L):
-                    row = self._grad_accum[i]
-                    block_sq += float(np.dot(row, row))
+            block_sq = float(np.sum(self._layer_sq))
             if jax.process_count() > 1:
                 # each host holds a disjoint span of the block grads —
                 # sum the partial squared norms across processes
@@ -769,9 +822,15 @@ class InfinityStepper:
                     np.float32(block_sq))))
             sq += block_sq
             gnorm = math.sqrt(sq) / gas
-            if self.clip > 0.0 and np.isfinite(gnorm) and gnorm > self.clip:
-                grad_scale *= gnorm / self.clip
-            self._sweep_collected(lr, grad_scale)
+            if self.clip > 0.0:
+                if np.isfinite(gnorm) and gnorm > self.clip:
+                    grad_scale *= gnorm / self.clip
+                # clip-gated sweep, parallel across layers/cores
+                sweep = [self._submit(i, self._apply_layer_from_accum,
+                                      lr, grad_scale)
+                         for i in range(self.L)]
+                for f in sweep:
+                    f.result()
         self._step_resident(res_acc, lr, grad_scale)
         self._dev.clear()   # device copies are stale after the sweep
         self._sweep_uploads(block=True)
@@ -967,7 +1026,8 @@ class InfinityStepper:
         self.opt.flush()
 
     def close(self) -> None:
-        self._worker.shutdown(wait=True)
+        for w in self._workers:
+            w.shutdown(wait=True)
         self.param_store.close()
         self.opt.close()
         if self._aio is not None:
