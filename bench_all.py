@@ -236,16 +236,23 @@ def infinity_bench(h2d_gbps: float, d2h_gbps: float):
     hbm = 16 << 30   # v5e
 
     ladder = ["350m", "760m", "1.3b", "2.7b", "6.7b", "13b"]
+    wire_bits = 1                  # stochastic-sign D2H grad wire (16x)
+    live_budget = int(4e9)         # device layer-cache params (8 GiB bf16)
     projections = {}
     chosen = None
     for name in ladder:
         c = TransformerConfig(**{"max_seq_len": seq, **GPT2_SIZES[name]})
         p = c.num_params()
         host = 14 * p               # 2 bf16 store + 12 opt state
-        # step ~= grads D2H + 2x param H2D + host adam sweep (1 core,
-        # ~3 GB/s effective over 16 bytes/param touched)
-        est = (2 * p / (d2h_gbps * 2**30 + 1) +
-               4 * p / (h2d_gbps * 2**30 + 1) + 16 * p / (3 * 2**30))
+        # step wire: fwd uploads every layer (2 bytes/param bf16); the
+        # backward re-uses the device layer cache up to live_budget and
+        # re-uploads the rest; grads cross D2H at wire_bits/8 bytes/param
+        per_layer = p / max(c.num_layers, 1)
+        cached = min(c.num_layers, int(live_budget // per_layer))
+        h2d_bytes = 2 * p + 2 * p * (1 - cached / max(c.num_layers, 1))
+        d2h_bytes = p * wire_bits / 8
+        est = (d2h_bytes / (d2h_gbps * 2**30 + 1) +
+               h2d_bytes / (h2d_gbps * 2**30 + 1) + 16 * p / (3 * 2**30))
         fits_ram = host < avail * 0.85
         projections[name] = {
             "params_b": round(p / 1e9, 2),
@@ -265,6 +272,8 @@ def infinity_bench(h2d_gbps: float, d2h_gbps: float):
             "bf16": {"enabled": True},
             "zero_optimization": {
                 "stage": 3, "infinity_host_init": True,
+                "offload_wire_bits": wire_bits,
+                "max_live_parameters": live_budget,
                 "offload_param": {"device": "cpu"},
                 "offload_optimizer": {"device": "cpu"}},
             "steps_per_print": 0}
@@ -294,6 +303,8 @@ def infinity_bench(h2d_gbps: float, d2h_gbps: float):
         "hbm_equivalent": round(16 * p / hbm, 2),
         "loss": round(float(m["loss"]), 3),
         "wire_d2h_gbps": round(d2h_gbps, 4),
+        "wire_bits": wire_bits,
+        "device_cache_layers": eng._infinity.max_live_layers,
         "projections": projections}), flush=True)
 
 

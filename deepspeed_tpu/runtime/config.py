@@ -133,6 +133,15 @@ class ZeroConfig(ConfigModel):
     # 4-bytes/param device→host fetch at startup, which dominates init time
     # on hosts with slow D2H links.
     infinity_host_init: bool = False
+    # ZeRO-Infinity D2H gradient-wire compression: 0 = off (bf16 wire),
+    # 8/4/1 = grouped stochastic-rounding quantization to that many bits
+    # before the device->host fetch (runtime/zero/wire_codec.py). The role
+    # the reference's 1-bit error-feedback compression plays on the
+    # network wire (runtime/comm/nccl.py:52), re-derived for a host
+    # offload wire where persistent device error state would cost HBM
+    # linear in total params: stochastic rounding is unbiased WITHOUT
+    # error memory.
+    offload_wire_bits: int = 0
 
     @model_validator(mode="after")
     def _resolve_deprecated(self):

@@ -77,6 +77,7 @@ import ml_dtypes
 import numpy as np
 
 from ...utils.logging import logger
+from . import wire_codec
 
 
 def _flatten_info(tpl):
@@ -131,8 +132,21 @@ class InfinityStepper:
         mesh = engine.mesh
         self.dp = topo.dp_world_size(mesh)
         # flat layer vector padded so it splits evenly into dp shards;
-        # both the vector and the batch ride the data-like axes
-        self.n_pad = -(-self.n_elems // self.dp) * self.dp
+        # both the vector and the batch ride the data-like axes. With wire
+        # compression each dp shard must also be a whole number of
+        # quantization chunks so every chip encodes its shard locally.
+        self.wire_bits = int(getattr(zc, "offload_wire_bits", 0) or 0)
+        if self.wire_bits not in (0, 1, 4, 8):
+            raise ValueError(
+                f"zero_optimization.offload_wire_bits must be 0, 1, 4 or 8; "
+                f"got {self.wire_bits}")
+        quantum = self.dp * (wire_codec.CHUNK if self.wire_bits else 1)
+        self.n_pad = -(-self.n_elems // quantum) * quantum
+        # device layer-cache budget: how many streamed layers may stay
+        # resident at once (2 = the minimal double-buffer; more turns the
+        # backward's re-uploads into cache hits when HBM allows)
+        self.max_live_layers = int(np.clip(
+            int(zc.max_live_parameters) // max(self.n_elems, 1), 2, self.L))
         self._flat_shard = topo.batch_sharding(mesh)
         self._batch_shard = topo.batch_sharding(mesh)
         self._repl = topo.replicated(mesh)
@@ -209,6 +223,11 @@ class InfinityStepper:
 
         # -- compiled programs (built lazily per batch-key signature) ------
         self._programs: Dict = {}
+        # wire-compression RNG: one base key, folded with a monotone
+        # sequence number per encoded layer-grad (deterministic, no
+        # device-side RNG state to checkpoint)
+        self._wire_base = jax.random.PRNGKey(0x1bad)
+        self._wire_seq = 0
         self._dev: Dict[int, jax.Array] = {}     # slot -> device bf16 vector
         self._pending_uploads: List[Tuple[int, jax.Array]] = []
         # Host optimizer parallelism: one single-thread executor per worker,
@@ -238,7 +257,12 @@ class InfinityStepper:
             f"{self.L} layers x {self.n_elems / 1e6:.1f}M elems, dp="
             f"{self.dp} (local span {self.n_local / 1e6:.1f}M); host "
             f"{host_gb:.1f} GiB, nvme {disk_gb:.1f} GiB "
-            f"(params={op.device.value}, optimizer={oo.device.value})")
+            f"(params={op.device.value}, optimizer={oo.device.value}); "
+            f"device layer cache {self.max_live_layers}/{self.L} layers "
+            f"(~{self.max_live_layers * self.n_pad * 2 / self.dp / 2**30:.2f}"
+            f" GiB/chip — zero_optimization.max_live_parameters bounds it)"
+            + (f"; D2H wire {self.wire_bits}-bit stochastic-rounded"
+               if self.wire_bits else ""))
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -429,12 +453,41 @@ class InfinityStepper:
                 np.asarray(sh.data))
         return out
 
+    def _fetch_span(self, arr: jax.Array) -> np.ndarray:
+        """Process-local span of any P(data)-sharded 1-D vector (wire
+        payload / scales — lengths proportional to n_pad)."""
+        if jax.process_count() == 1:
+            return np.asarray(arr)
+        shards = sorted(((0 if sh.index[0].start is None
+                          else int(sh.index[0].start), sh.data)
+                         for sh in arr.addressable_shards))
+        return np.concatenate([np.asarray(d) for _, d in shards])
+
+    def _decode_wire(self, wire, out: np.ndarray,
+                     accumulate: bool) -> None:
+        """Host side of the compressed grad wire: fetch payload + scales
+        (process-local spans) and decode into the fp32 vector."""
+        payload = self._fetch_span(wire[0])
+        scales = self._fetch_span(wire[1])
+        wire_codec.decode_into(out, payload, scales, self.wire_bits,
+                               accumulate=accumulate)
+
     def _ensure_layer(self, i: int, keep) -> jax.Array:
+        """Device copy of layer i's sharded param vector, uploading from
+        the host store on miss. Eviction honours
+        ``zero_optimization.max_live_parameters`` (reference stage3
+        max_live_parameters budget): layers stay resident up to the budget
+        so the backward walk re-uses the forward's uploads instead of
+        re-crossing the H2D wire — oldest-uploaded evicted first (on a
+        forward sweep that keeps exactly the layers the backward needs
+        first)."""
         if i in self._dev:
             return self._dev[i]
-        for k in list(self._dev):
-            if k not in keep:
-                del self._dev[k]
+        while len(self._dev) >= self.max_live_layers:
+            victim = next((k for k in self._dev if k not in keep), None)
+            if victim is None:
+                break
+            del self._dev[victim]
         self._sweep_uploads()
         buf = self.param_store.acquire(i)
         host = buf[:self.n_local * 2].view(ml_dtypes.bfloat16)
@@ -567,6 +620,11 @@ class InfinityStepper:
                 embed_vjp=jax.jit(embed_vjp, out_shardings=self._repl),
                 res_combine=jax.jit(res_combine, out_shardings=(
                     self._repl, self._repl)),
+                encode_grad=(jax.jit(
+                    lambda dflat, k: wire_codec.encode(
+                        dflat, self.wire_bits, k),
+                    out_shardings=(self._flat_shard, self._flat_shard))
+                    if self.wire_bits else None),
                 eval_loss=jax.jit(
                     lambda res, xL, ids, labels, mask:
                     head_loss(res, xL, ids, labels, mask),
@@ -650,12 +708,23 @@ class InfinityStepper:
                 self._ensure_layer(i - 1, {i, i - 1})
             dflat, dy, sq = progs["block_vjp"](self._dev[i], acts[i], dy)
             acts[i] = None
-            try:
-                dflat.copy_to_host_async()
-            except Exception:
-                pass
+            if self.wire_bits:
+                # quantize on device; only the packed payload + per-chunk
+                # scales cross the D2H wire (wire_codec: unbiased
+                # stochastic rounding, no persistent error state)
+                self._wire_seq += 1
+                wire = progs["encode_grad"](
+                    dflat, jax.random.fold_in(self._wire_base,
+                                              self._wire_seq))
+            else:
+                wire = dflat
+            for part in (wire if isinstance(wire, tuple) else (wire,)):
+                try:
+                    part.copy_to_host_async()
+                except Exception:
+                    pass
             sqs.append(sq)
-            on_layer_grad(i, dflat)
+            on_layer_grad(i, wire)
         d_res_embed = progs["embed_vjp"](self.resident, ids_dev, tt_dev, dy)
         d_res, res_sq = progs["res_combine"](d_res_head, d_res_embed)
         total_sq = res_sq + sum(sqs)
@@ -664,15 +733,20 @@ class InfinityStepper:
     # ------------------------------------------------------------------
     # optimizer application
     # ------------------------------------------------------------------
-    def _step_layer(self, i: int, dflat, lr: float,
+    def _step_layer(self, i: int, wire, lr: float,
                     grad_scale: float) -> None:
         """Worker-thread task: D2H-complete grad → native Adam sweep →
         bf16 emit into the param store slot (stream mode)."""
-        g = self._fetch_flat(dflat)     # bf16 (ml_dtypes) — wire format
+        if self.wire_bits:
+            g32 = np.empty(self.n_local, np.float32)
+            self._decode_wire(wire, g32, accumulate=False)
+            g = g32
+        else:
+            g = self._fetch_flat(wire).view(np.uint16)  # bf16 wire format
         self.opt.prefetch(i)
         pbuf = self.param_store.acquire(i)
         out16 = pbuf[:self.n_local * 2].view(np.uint16)
-        self.opt.step_slot(i, g.view(np.uint16), lr=lr,
+        self.opt.step_slot(i, g, lr=lr,
                            grad_scale=grad_scale, out_bf16=out16)
         self.param_store.release(i, dirty=True)
 
@@ -681,12 +755,15 @@ class InfinityStepper:
         per-layer ordering, parallelizes across layers."""
         return self._workers[i % len(self._workers)].submit(fn, i, *args)
 
-    def _accum_layer(self, i: int, dflat) -> None:
-        """Worker-thread task: accumulate bf16 grads into the fp32 host
+    def _accum_layer(self, i: int, wire) -> None:
+        """Worker-thread task: accumulate the wire grad into the fp32 host
         store (collect mode). ``_grad_accum`` is allocated by the main
         thread before any submission (lazy alloc here would race across
         workers)."""
-        g = self._fetch_flat(dflat).view(np.uint16)
+        if self.wire_bits:
+            self._decode_wire(wire, self._grad_accum[i], accumulate=True)
+            return
+        g = self._fetch_flat(wire).view(np.uint16)
         if self._native is not None:
             from ...ops.adam.cpu_adam import _C_F32, _C_U16, _ptr
             self._native.ds_accum_g16(self.n_local,
@@ -800,6 +877,11 @@ class InfinityStepper:
             sq_total += float(sq)
             res_acc = d_res if res_acc is None else self._res_add(res_acc,
                                                                  d_res)
+        # Release every upload pin BEFORE blocking on the workers: once
+        # this thread parks in result(), nobody else may reclaim them
+        # (slot_store.reclaim is gated to the stream thread), and a worker
+        # needing a param-ring buffer would starve against our own pins.
+        self._sweep_uploads(block=True)
         for f in futures:
             f.result()   # surface worker exceptions, join the sweep
 

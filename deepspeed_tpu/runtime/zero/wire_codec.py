@@ -1,0 +1,126 @@
+"""Gradient-wire codec for the ZeRO-Infinity device->host stream.
+
+Role-equivalent of the reference's 1-bit error-feedback compression
+(`/root/reference/deepspeed/runtime/comm/nccl.py:52-204`
+``compressed_allreduce``) applied to a different wire: the reference
+compresses the *network* collective for 1-bit Adam; here the scarce link
+is the *D2H offload wire* that carries every streamed layer gradient to
+the host Adam sweep (`runtime/zero/infinity.py`).
+
+Design departure, stated for the record: the reference's scheme keeps a
+persistent per-tensor error-feedback buffer on the worker. On the
+beyond-HBM engine that buffer would live in device HBM and cost
+2-4 bytes/param across ALL layers — i.e. as much memory as holding the
+entire sharded model resident, which is exactly what ZeRO-Infinity exists
+to avoid. Instead this codec uses **grouped stochastic rounding**:
+per-chunk max-abs scales plus randomized rounding make the quantizer
+unbiased (E[decode(encode(g))] = g) with NO persistent state, so the bias
+that error feedback exists to repair never arises; the variance averages
+out across gradient accumulation and Adam's moment EMAs. (The network-
+collective 1-bit path with true error feedback remains available in
+`runtime/comm/compressed.py` where the error buffer is dp-sharded and
+cheap.)
+
+Wire formats (per layer vector of n elements, chunk = ``CHUNK``):
+  8-bit: int8 values + f32 scale per chunk          -> n bytes   (2x vs bf16)
+  4-bit: two values per byte + f32 scale per chunk  -> n/2 bytes (4x)
+  1-bit: sign bits packed 8/byte + f32 scale        -> n/8 bytes (16x)
+
+Encode runs jitted on device (output sharded like the flat grad vector so
+each chip packs only its shard); decode is vectorized numpy on the host,
+accumulating straight into the fp32 sweep buffer.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: quantization group size — one f32 scale per CHUNK elements; 2048 keeps
+#: the scale overhead at 0.2% of the 8-bit wire and aligns with the 8/dp
+#: divisibility the packed formats need
+CHUNK = 2048
+
+
+def wire_bytes(n: int, bits: int) -> int:
+    """Wire volume of one encoded vector (payload + scales)."""
+    n_chunks = (n + CHUNK - 1) // CHUNK
+    payload = {8: n, 4: n // 2, 1: n // 8}[bits]
+    return payload + 4 * n_chunks
+
+
+# ---------------------------------------------------------------------------
+# device-side encode (jit-compiled by the caller)
+# ---------------------------------------------------------------------------
+def _chunk_scales(flat: jnp.ndarray, levels: float) -> jnp.ndarray:
+    """Per-chunk max-abs / levels; 0-chunks get scale 1 (payload is 0)."""
+    chunks = flat.reshape(-1, CHUNK)
+    amax = jnp.max(jnp.abs(chunks), axis=1)
+    return jnp.where(amax > 0, amax / levels, 1.0)
+
+
+def encode(flat: jnp.ndarray, bits: int, key: jnp.ndarray
+           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """bf16/f32 [n] (n % CHUNK == 0) -> (payload uint8, scales f32).
+
+    Stochastic rounding: q = floor(g/s + u), u ~ U[0,1), so E[q·s] = g.
+    """
+    n = flat.shape[0]
+    if n % CHUNK:
+        raise ValueError(f"wire codec needs n % {CHUNK} == 0, got {n}")
+    x = flat.astype(jnp.float32)
+    if bits == 1:
+        # unbiased sign: q in {-s, +s} with P(+s) = (g + s) / (2s),
+        # s = per-chunk max|g| — E[q] = g exactly, |g| <= s by construction.
+        # All-zero chunks return s = 0 (the sign payload is never zero, so
+        # the scale must carry the zero).
+        amax = jnp.max(jnp.abs(x.reshape(-1, CHUNK)), axis=1)
+        s = amax
+        xs = x.reshape(-1, CHUNK) / jnp.where(amax > 0, amax, 1.0)[:, None]
+        p_up = (xs + 1.0) * 0.5
+        u = jax.random.uniform(key, xs.shape)
+        bit = (u < p_up).astype(jnp.uint8)                # 1 -> +s, 0 -> -s
+        weights = (2 ** jnp.arange(8, dtype=jnp.uint8))[None, :]
+        packed = jnp.sum(bit.reshape(-1, 8) * weights, axis=1,
+                         dtype=jnp.uint8)
+        return packed, s
+    levels = {8: 127.0, 4: 7.0}[bits]
+    s = _chunk_scales(x, levels)
+    xs = x.reshape(-1, CHUNK) / s[:, None]                # in [-levels, levels]
+    u = jax.random.uniform(key, xs.shape)
+    q = jnp.clip(jnp.floor(xs + u), -levels, levels).astype(jnp.int8)
+    if bits == 8:
+        return jax.lax.bitcast_convert_type(q.reshape(-1), jnp.uint8), s
+    # 4-bit: offset to [0, 14], two nibbles per byte
+    q4 = (q + 7).astype(jnp.uint8).reshape(-1, 2)
+    return (q4[:, 0] | (q4[:, 1] << 4)), s
+
+
+# ---------------------------------------------------------------------------
+# host-side decode (numpy; the worker thread's side of the wire)
+# ---------------------------------------------------------------------------
+def decode_into(out: np.ndarray, payload: np.ndarray, scales: np.ndarray,
+                bits: int, accumulate: bool = False) -> None:
+    """payload/scales (host numpy) -> fp32 [n]; ``accumulate`` adds into
+    ``out`` (the collect-mode fp32 grad row) instead of overwriting."""
+    n = out.shape[0]
+    if bits == 1:
+        bit = np.unpackbits(payload, bitorder="little")[:n]
+        vals = (bit.astype(np.float32) * 2.0 - 1.0)
+    elif bits == 8:
+        vals = payload.view(np.int8).astype(np.float32)
+    elif bits == 4:
+        lo = (payload & 0x0F).astype(np.int16) - 7
+        hi = (payload >> 4).astype(np.int16) - 7
+        vals = np.empty(n, np.float32)
+        vals[0::2] = lo
+        vals[1::2] = hi
+    else:
+        raise ValueError(f"bits={bits}")
+    vals = vals.reshape(-1, CHUNK) * scales[:, None].astype(np.float32)
+    if accumulate:
+        out += vals.reshape(-1)
+    else:
+        out[:] = vals.reshape(-1)

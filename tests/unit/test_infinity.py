@@ -209,6 +209,104 @@ class TestSlotOptimizer:
 
 
 # ---------------------------------------------------------------------------
+# gradient-wire codec
+# ---------------------------------------------------------------------------
+class TestWireCodec:
+    """Unbiased stochastic-rounding D2H compression (wire_codec.py) — the
+    role the reference's 1-bit error-feedback collective plays on the
+    network wire (`runtime/comm/nccl.py:52`), re-derived for the offload
+    wire (no persistent device error state)."""
+
+    @pytest.mark.parametrize("bits", [8, 4, 1])
+    def test_roundtrip_error_bounded(self, bits):
+        from deepspeed_tpu.runtime.zero import wire_codec as wc
+        n = 4 * wc.CHUNK
+        g = np.asarray(jax.random.normal(jax.random.PRNGKey(0), (n,)),
+                       np.float32)
+        payload, scales = jax.jit(wc.encode, static_argnums=1)(
+            g, bits, jax.random.PRNGKey(1))
+        out = np.empty(n, np.float32)
+        wc.decode_into(out, np.asarray(payload), np.asarray(scales), bits)
+        # error bounded by one quantization step per element
+        step = np.repeat(np.asarray(scales), wc.CHUNK)
+        if bits == 1:
+            assert np.all(np.abs(out - g) <= 2 * step + 1e-6)
+        else:
+            assert np.all(np.abs(out - g) <= step + 1e-6)
+        # wire volume is what the format promises
+        assert payload.nbytes == {8: n, 4: n // 2, 1: n // 8}[bits]
+
+    @pytest.mark.parametrize("bits", [8, 4, 1])
+    def test_unbiased(self, bits):
+        """E[decode(encode(g))] = g — the property that replaces error
+        feedback. Average over many independent keys."""
+        from deepspeed_tpu.runtime.zero import wire_codec as wc
+        n = wc.CHUNK
+        g = np.asarray(jax.random.normal(jax.random.PRNGKey(0), (n,)),
+                       np.float32) * 0.1
+        reps = 300 if bits == 1 else 100
+        enc = jax.jit(wc.encode, static_argnums=1)
+        acc = np.zeros(n, np.float64)
+        out = np.empty(n, np.float32)
+        for r in range(reps):
+            payload, scales = enc(g, bits, jax.random.PRNGKey(100 + r))
+            wc.decode_into(out, np.asarray(payload), np.asarray(scales),
+                           bits)
+            acc += out
+        mean = acc / reps
+        # 5-sigma tolerance on the SR noise of the mean
+        sig = {8: np.max(np.abs(g)) / 127, 4: np.max(np.abs(g)) / 7,
+               1: np.max(np.abs(g))}[bits] / np.sqrt(reps)
+        assert np.max(np.abs(mean - g)) < 5 * max(sig, 1e-8)
+
+    def test_zero_chunks_decode_to_zero(self):
+        from deepspeed_tpu.runtime.zero import wire_codec as wc
+        g = np.zeros(2 * wc.CHUNK, np.float32)
+        for bits in (8, 4, 1):
+            payload, scales = jax.jit(wc.encode, static_argnums=1)(
+                g, bits, jax.random.PRNGKey(0))
+            out = np.ones_like(g)
+            wc.decode_into(out, np.asarray(payload), np.asarray(scales),
+                           bits)
+            np.testing.assert_array_equal(out, 0.0)
+
+    @pytest.mark.parametrize("bits", [8, 1])
+    def test_compressed_training_converges(self, bits):
+        """Verdict r3 #3 'Done' condition: convergence parity vs the
+        uncompressed wire on a small model."""
+        rng = jax.random.PRNGKey(0)
+        ids = ids_batch()
+        zero = dict(infinity_zero(), offload_wire_bits=bits)
+        eng = DeepSpeedEngine(tiny_model(), config=engine_cfg(zero=zero),
+                              rng=rng, mesh=single_mesh())
+        ref = DeepSpeedEngine(tiny_model(),
+                              config=engine_cfg(zero=infinity_zero()),
+                              rng=rng, mesh=single_mesh())
+        l0 = eng.eval_loss({"input_ids": ids})
+        for _ in range(8):
+            eng.train_step({"input_ids": ids})
+            ref.train_step({"input_ids": ids})
+        l1 = eng.eval_loss({"input_ids": ids})
+        lr = ref.eval_loss({"input_ids": ids})
+        assert float(l1) < float(l0) - 0.3       # memorizes the batch
+        # trajectory parity: compressed end-loss within a band of exact
+        band = 0.15 if bits == 8 else 0.5
+        assert abs(float(l1) - float(lr)) < band
+
+    def test_wire_with_gas_and_clip(self):
+        zero = dict(infinity_zero(), offload_wire_bits=8)
+        eng = DeepSpeedEngine(
+            tiny_model(),
+            config=engine_cfg(gas=2, clip=0.5, batch=8, zero=zero),
+            rng=jax.random.PRNGKey(0), mesh=single_mesh())
+        ids = ids_batch(n=8)
+        losses = [eng.train_step({"input_ids": ids})["loss"]
+                  for _ in range(6)]
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
 # streamed engine
 # ---------------------------------------------------------------------------
 class TestInfinityEngine:
@@ -540,6 +638,24 @@ class TestInfinityMultiChip:
         assert shard.data.shape == (st.n_pad // 8,)
         assert len({s.device for s in arr.addressable_shards}) == 8
         st._sweep_uploads(block=True)
+
+    def test_dp8_wire_compression(self):
+        """Wire compression composes with the dp-sharded mesh: every chip
+        encodes its own shard (payload/scales stay P(data)-sharded) and
+        training still converges."""
+        rng = jax.random.PRNGKey(0)
+        ids = ids_batch(n=8)
+        zero = dict(infinity_zero(), offload_wire_bits=8)
+        eng = DeepSpeedEngine(tiny_model(), config=dp_cfg(zero=zero, dp=8),
+                              rng=rng, mesh=dp8_mesh())
+        st = eng._infinity
+        assert st.wire_bits == 8 and st.n_pad % (8 * 2048) == 0
+        l0 = eng.eval_loss({"input_ids": ids})
+        for _ in range(6):
+            m = eng.train_step({"input_ids": ids})
+            assert np.isfinite(m["loss"])
+        l1 = eng.eval_loss({"input_ids": ids})
+        assert float(l1) < float(l0) - 0.2
 
     def test_dp8_gas_clip_and_convergence(self):
         rng = jax.random.PRNGKey(0)
