@@ -156,6 +156,36 @@ def blocksparse_bench(seq: int = 8192, heads: int = 8, d: int = 128,
         "layout_density": round(2 / (2 * seq // 512), 3)}), flush=True)
 
 
+def diffusion_bench(iters: int = 4):
+    """SD-v1.5-geometry UNet denoising step latency (BASELINE.md tracked
+    config 'Stable-Diffusion inference with kernel injection'): full
+    320/640/1280/1280 UNet at 64x64 latents with CFG (batch doubles),
+    77-token text context, bf16."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.diffusion import (UNet2DCondition,
+                                                UNetConfig)
+    cfg = UNetConfig(dtype=jnp.bfloat16)
+    unet = UNet2DCondition(cfg)
+    params = jax.jit(unet.init)(jax.random.PRNGKey(0))
+    step = jax.jit(unet.apply)
+    lat = jnp.zeros((2, 64, 64, 4), jnp.bfloat16)      # CFG pair
+    ctx = jnp.zeros((2, 77, 768), jnp.bfloat16)
+    t = jnp.array([500, 500], jnp.int32)
+    out = step(params, lat, t, ctx)
+    np.asarray(jax.device_get(out[0, 0, 0]))           # sync barrier
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(params, out, t, ctx)
+    np.asarray(jax.device_get(out[0, 0, 0]))
+    ms = (time.perf_counter() - t0) / iters * 1e3
+    print(json.dumps({
+        "metric": "sd15_unet_step_latency", "value": round(ms, 1),
+        "unit": "ms", "latents": "2x64x64x4 (cfg pair)",
+        "steps_per_sec": round(1000.0 / ms, 2),
+        "est_50step_image_s": round(ms * 50 / 1000.0, 1)}), flush=True)
+
+
 def wire_bench(mb: int = 32):
     """Measured host<->device wire roofline — the hard bound on every
     offload design on this machine; reported in-band so offload numbers
@@ -318,6 +348,7 @@ def main():
         decode_bench()
         decode16k_bench()
         blocksparse_bench()
+        diffusion_bench()
         h2d, d2h = wire_bench()
         offload_bench()
         infinity_bench(h2d, d2h)

@@ -86,6 +86,50 @@ def init_inference(model: Any = None, config: Any = None,
     return InferenceEngine(model, cfg, params=params, mesh=mesh)
 
 
+def init_diffusion(unet_config=None, vae_config=None, text_config=None,
+                   state_dicts=None, params=None, scheduler=None):
+    """Build a Stable-Diffusion-class serving pipeline — the TPU-native
+    equivalent of the reference's ``generic_injection`` over a diffusers
+    pipeline (`module_inject/replace_module.py:211`,
+    `model_implementations/diffusers/unet.py` DSUNet): jit-compiled UNet
+    step + VAE decode replace CUDA-graph capture; XLA fuses the bias-add/
+    GroupNorm chains the reference hand-wrote in ``csrc/spatial``.
+
+    ``state_dicts`` — optional dict with any of "unet" / "vae" /
+    "text_encoder" mapping to HF-named checkpoints (diffusers /
+    transformers conventions); missing entries fall back to ``params`` or
+    fresh initialization.
+    """
+    import jax as _jax
+    from .models.diffusion import (AutoencoderKL, CLIPTextConfig,
+                                   CLIPTextEncoder, StableDiffusionPipeline,
+                                   UNet2DCondition, UNetConfig, VAEConfig)
+    from .module_inject import diffusion_policies as pol
+    unet = UNet2DCondition(unet_config or UNetConfig())
+    vae = AutoencoderKL(vae_config or VAEConfig())
+    text = CLIPTextEncoder(text_config or CLIPTextConfig())
+    sds = state_dicts or {}
+    unknown = set(sds) - {"unet", "vae", "text_encoder"}
+    if unknown:
+        raise ValueError(
+            f"init_diffusion: unknown state_dicts entries {sorted(unknown)}"
+            f" — expected a subset of ['unet', 'vae', 'text_encoder'] "
+            f"(refusing a silent partial load)")
+    params = dict(params or {})
+    if "unet" in sds:
+        params["unet"] = pol.load_unet(unet.config, sds["unet"])
+    if "vae" in sds:
+        params["vae"] = pol.load_vae(vae.config, sds["vae"])
+    if "text_encoder" in sds:
+        params["text_encoder"] = pol.load_clip_text(text.config,
+                                                    sds["text_encoder"])
+    for name, mod in (("unet", unet), ("vae", vae), ("text_encoder", text)):
+        if name not in params:
+            params[name] = mod.init(_jax.random.PRNGKey(0))
+    pipe = StableDiffusionPipeline(unet, vae, text, scheduler=scheduler)
+    return pipe, params
+
+
 def add_config_arguments(parser):
     """Reference `deepspeed/__init__.py:210` — argparse plumbing."""
     group = parser.add_argument_group("DeepSpeed-TPU",

@@ -1,0 +1,476 @@
+"""Diffusion model family: UNet / VAE / CLIP text + HF weight policies.
+
+Mirrors the reference's diffusers-injection coverage
+(`/root/reference/tests/unit/inference/test_inference.py` runs SD through
+`generic_injection`; `replace_module.py:211`): since the diffusers
+package is not in this image, parity is established at the strongest
+available boundaries — the CLIP text tower against the installed
+``transformers`` torch implementation end-to-end, and every UNet/VAE
+building block against a torch reference implementation with weights
+round-tripped through the HF-naming policy loader (which is exactly the
+layout-conversion surface where injection bugs live).
+"""
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import torch
+import torch.nn.functional as F
+
+from deepspeed_tpu.models.diffusion import (
+    AutoencoderKL, CLIPTextConfig, CLIPTextEncoder, DDIMScheduler,
+    StableDiffusionPipeline, UNet2DCondition, UNetConfig, VAEConfig,
+    conv_apply, groupnorm_apply, silu, timestep_embedding,
+    _basic_tblock_apply, _resnet_apply)
+from deepspeed_tpu.module_inject.diffusion_policies import (
+    load_clip_text, load_unet, load_vae, _SD, _conv, _norm, _linear,
+    _resnet as _load_resnet, _tblock as _load_tblock)
+
+torch.manual_seed(0)
+
+
+def t2n(t):
+    return t.detach().cpu().numpy()
+
+
+# ---------------------------------------------------------------------------
+# primitive parity vs torch
+# ---------------------------------------------------------------------------
+class TestPrimitives:
+    def test_conv_matches_torch(self):
+        x = torch.randn(2, 8, 10, 10)                  # NCHW
+        conv = torch.nn.Conv2d(8, 16, 3, padding=1)
+        ref = t2n(conv(x)).transpose(0, 2, 3, 1)       # -> NHWC
+        p = {"kernel": jnp.asarray(t2n(conv.weight).transpose(2, 3, 1, 0)),
+             "bias": jnp.asarray(t2n(conv.bias))}
+        got = conv_apply(p, jnp.asarray(t2n(x).transpose(0, 2, 3, 1)))
+        np.testing.assert_allclose(np.asarray(got), ref, atol=2e-5)
+
+    def test_strided_conv_matches_torch(self):
+        x = torch.randn(1, 4, 8, 8)
+        conv = torch.nn.Conv2d(4, 4, 3, stride=2, padding=1)
+        ref = t2n(conv(x)).transpose(0, 2, 3, 1)
+        p = {"kernel": jnp.asarray(t2n(conv.weight).transpose(2, 3, 1, 0)),
+             "bias": jnp.asarray(t2n(conv.bias))}
+        got = conv_apply(p, jnp.asarray(t2n(x).transpose(0, 2, 3, 1)),
+                         stride=2)
+        np.testing.assert_allclose(np.asarray(got), ref, atol=2e-5)
+
+    def test_groupnorm_matches_torch(self):
+        x = torch.randn(2, 16, 6, 6)
+        gn = torch.nn.GroupNorm(4, 16)
+        with torch.no_grad():
+            gn.weight.copy_(torch.randn(16))
+            gn.bias.copy_(torch.randn(16))
+        ref = t2n(gn(x)).transpose(0, 2, 3, 1)
+        p = {"scale": jnp.asarray(t2n(gn.weight)),
+             "bias": jnp.asarray(t2n(gn.bias))}
+        got = groupnorm_apply(p, jnp.asarray(t2n(x).transpose(0, 2, 3, 1)),
+                              groups=4)
+        np.testing.assert_allclose(np.asarray(got), ref, atol=2e-5)
+
+    def test_timestep_embedding_matches_diffusers_formula(self):
+        # diffusers get_timestep_embedding(flip_sin_to_cos=True, shift=0)
+        t = np.array([0, 1, 500, 999], np.float32)
+        dim, half = 32, 16
+        freqs = np.exp(-math.log(10000) * np.arange(half) / half)
+        args = t[:, None] * freqs[None, :]
+        ref = np.concatenate([np.cos(args), np.sin(args)], axis=-1)
+        got = np.asarray(timestep_embedding(jnp.asarray(t), dim))
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# torch reference blocks (public SD architecture, built for parity only)
+# ---------------------------------------------------------------------------
+class TorchResnet(torch.nn.Module):
+    def __init__(self, cin, cout, temb, groups=8):
+        super().__init__()
+        self.norm1 = torch.nn.GroupNorm(groups, cin)
+        self.conv1 = torch.nn.Conv2d(cin, cout, 3, padding=1)
+        self.time_emb_proj = torch.nn.Linear(temb, cout)
+        self.norm2 = torch.nn.GroupNorm(groups, cout)
+        self.conv2 = torch.nn.Conv2d(cout, cout, 3, padding=1)
+        self.conv_shortcut = (torch.nn.Conv2d(cin, cout, 1)
+                              if cin != cout else None)
+
+    def forward(self, x, temb):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = h + self.time_emb_proj(F.silu(temb))[:, :, None, None]
+        h = self.conv2(F.silu(self.norm2(h)))
+        if self.conv_shortcut is not None:
+            x = self.conv_shortcut(x)
+        return x + h
+
+
+class TorchTBlock(torch.nn.Module):
+    """BasicTransformerBlock: self-attn, cross-attn, GEGLU."""
+
+    def __init__(self, d, ctx, heads):
+        super().__init__()
+        self.heads = heads
+        self.norm1 = torch.nn.LayerNorm(d)
+        self.norm2 = torch.nn.LayerNorm(d)
+        self.norm3 = torch.nn.LayerNorm(d)
+        mk = lambda i, o, b: torch.nn.Linear(i, o, bias=b)
+        self.attn1 = torch.nn.ModuleDict(
+            {"to_q": mk(d, d, False), "to_k": mk(d, d, False),
+             "to_v": mk(d, d, False), "out": mk(d, d, True)})
+        self.attn2 = torch.nn.ModuleDict(
+            {"to_q": mk(d, d, False), "to_k": mk(ctx, d, False),
+             "to_v": mk(ctx, d, False), "out": mk(d, d, True)})
+        self.ff_in = torch.nn.Linear(d, 8 * d)
+        self.ff_out = torch.nn.Linear(4 * d, d)
+
+    def _attn(self, m, q_in, kv_in):
+        b, tq, d = q_in.shape
+        h = self.heads
+        dh = d // h
+        q = m["to_q"](q_in).view(b, tq, h, dh).transpose(1, 2)
+        k = m["to_k"](kv_in).view(b, -1, h, dh).transpose(1, 2)
+        v = m["to_v"](kv_in).view(b, -1, h, dh).transpose(1, 2)
+        a = torch.softmax(q @ k.transpose(-1, -2) / math.sqrt(dh), dim=-1)
+        o = (a @ v).transpose(1, 2).reshape(b, tq, d)
+        return m["out"](o)
+
+    def forward(self, x, ctx):
+        x = x + self._attn(self.attn1, self.norm1(x), self.norm1(x))
+        x = x + self._attn(self.attn2, self.norm2(x), ctx)
+        h = self.ff_in(self.norm3(x))
+        a, g = h.chunk(2, dim=-1)
+        return x + self.ff_out(a * F.gelu(g))
+
+
+class TestBlocksVsTorch:
+    def test_resnet_block_parity_through_policy(self):
+        """Weights exported with diffusers names, loaded by the policy
+        loader, forward compared against the torch reference."""
+        tb = TorchResnet(8, 16, 32)
+        sd = {f"res.{k}": v for k, v in tb.state_dict().items()}
+        p = _load_resnet(_SD(sd), "res", temb=True)
+        x = torch.randn(2, 8, 6, 6)
+        temb = torch.randn(2, 32)
+        ref = t2n(tb(x, temb)).transpose(0, 2, 3, 1)
+        got = _resnet_apply(p, jnp.asarray(t2n(x).transpose(0, 2, 3, 1)),
+                            jnp.asarray(t2n(temb)), groups=8)
+        np.testing.assert_allclose(np.asarray(got), ref, atol=1e-4)
+
+    def test_transformer_block_parity_through_policy(self):
+        tb = TorchTBlock(16, 12, heads=4)
+        name = {"attn1.out": "attn1.to_out.0", "attn2.out": "attn2.to_out.0",
+                "ff_in": "ff.net.0.proj", "ff_out": "ff.net.2"}
+        sd = {}
+        for k, v in tb.state_dict().items():
+            nk = k
+            for a, b in name.items():
+                nk = nk.replace(a, b)
+            sd[f"blk.{nk}"] = v
+        p = _load_tblock(_SD(sd), "blk")
+        x = torch.randn(2, 9, 16)
+        ctx = torch.randn(2, 5, 12)
+        ref = t2n(tb(x, ctx))
+        got = _basic_tblock_apply(p, jnp.asarray(t2n(x)),
+                                  jnp.asarray(t2n(ctx)), heads=4)
+        np.testing.assert_allclose(np.asarray(got), ref, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# CLIP text: end-to-end parity vs installed transformers
+# ---------------------------------------------------------------------------
+class TestCLIPParity:
+    def test_logit_parity_vs_hf(self):
+        from transformers import CLIPTextConfig as HFConfig
+        from transformers import CLIPTextModel
+        hf_cfg = HFConfig(vocab_size=99, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=3,
+                          num_attention_heads=4,
+                          max_position_embeddings=16,
+                          hidden_act="quick_gelu")
+        hf = CLIPTextModel(hf_cfg).eval()
+        cfg = CLIPTextConfig(vocab_size=99, hidden_size=32,
+                             intermediate_size=64, num_hidden_layers=3,
+                             num_attention_heads=4,
+                             max_position_embeddings=16)
+        params = load_clip_text(cfg, hf.state_dict())
+        ids = torch.randint(0, 99, (2, 16))
+        with torch.no_grad():
+            ref = t2n(hf(input_ids=ids).last_hidden_state)
+        got = CLIPTextEncoder(cfg).apply(params,
+                                         jnp.asarray(t2n(ids)))
+        np.testing.assert_allclose(np.asarray(got), ref, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# loaders: full synthetic checkpoints (name coverage + loud failure)
+# ---------------------------------------------------------------------------
+def tiny_unet_cfg():
+    return UNetConfig(block_out_channels=(32, 64), layers_per_block=1,
+                      cross_attention_dim=24, attention_head_dim=2,
+                      down_block_types=("CrossAttnDownBlock2D",
+                                        "DownBlock2D"),
+                      up_block_types=("UpBlock2D", "CrossAttnUpBlock2D"),
+                      norm_num_groups=8, sample_size=8)
+
+
+def synth_unet_sd(cfg):
+    """Random state dict with exact diffusers naming for the config."""
+    rs = np.random.RandomState(0)
+    sd = {}
+
+    def conv(name, cin, cout, k=3):
+        sd[f"{name}.weight"] = rs.randn(cout, cin, k, k).astype(np.float32) * 0.05
+        sd[f"{name}.bias"] = rs.randn(cout).astype(np.float32) * 0.01
+
+    def lin(name, cin, cout, bias=True):
+        sd[f"{name}.weight"] = rs.randn(cout, cin).astype(np.float32) * 0.05
+        if bias:
+            sd[f"{name}.bias"] = rs.randn(cout).astype(np.float32) * 0.01
+
+    def norm(name, c):
+        sd[f"{name}.weight"] = np.ones(c, np.float32)
+        sd[f"{name}.bias"] = np.zeros(c, np.float32)
+
+    def resnet(name, cin, cout, temb):
+        norm(f"{name}.norm1", cin)
+        conv(f"{name}.conv1", cin, cout)
+        lin(f"{name}.time_emb_proj", temb, cout)
+        norm(f"{name}.norm2", cout)
+        conv(f"{name}.conv2", cout, cout)
+        if cin != cout:
+            conv(f"{name}.conv_shortcut", cin, cout, k=1)
+
+    def tblock(name, d, ctx):
+        for ni in ("norm1", "norm2", "norm3"):
+            norm(f"{name}.{ni}", d)
+        for att, kv in (("attn1", d), ("attn2", ctx)):
+            lin(f"{name}.{att}.to_q", d, d, False)
+            lin(f"{name}.{att}.to_k", kv, d, False)
+            lin(f"{name}.{att}.to_v", kv, d, False)
+            lin(f"{name}.{att}.to_out.0", d, d)
+        lin(f"{name}.ff.net.0.proj", d, 8 * d)
+        lin(f"{name}.ff.net.2", 4 * d, d)
+
+    def t2d(name, c, ctx, depth):
+        norm(f"{name}.norm", c)
+        conv(f"{name}.proj_in", c, c, k=1)
+        for k in range(depth):
+            tblock(f"{name}.transformer_blocks.{k}", c, ctx)
+        conv(f"{name}.proj_out", c, c, k=1)
+
+    bo = cfg.block_out_channels
+    temb = bo[0] * 4
+    conv("conv_in", cfg.in_channels, bo[0])
+    lin("time_embedding.linear_1", bo[0], temb)
+    lin("time_embedding.linear_2", temb, temb)
+    ch = bo[0]
+    for bi, btype in enumerate(cfg.down_block_types):
+        cout = bo[bi]
+        for li in range(cfg.layers_per_block):
+            resnet(f"down_blocks.{bi}.resnets.{li}",
+                   ch if li == 0 else cout, cout, temb)
+            if btype == "CrossAttnDownBlock2D":
+                t2d(f"down_blocks.{bi}.attentions.{li}", cout,
+                    cfg.cross_attention_dim, cfg.transformer_depth)
+        if bi != len(bo) - 1:
+            conv(f"down_blocks.{bi}.downsamplers.0.conv", cout, cout)
+        ch = cout
+    resnet("mid_block.resnets.0", ch, ch, temb)
+    t2d("mid_block.attentions.0", ch, cfg.cross_attention_dim,
+        cfg.transformer_depth)
+    resnet("mid_block.resnets.1", ch, ch, temb)
+    rev = list(reversed(bo))
+    for bi, btype in enumerate(cfg.up_block_types):
+        cout = rev[bi]
+        prev = rev[max(bi - 1, 0)]
+        skip_base = rev[min(bi + 1, len(rev) - 1)]
+        for li in range(cfg.layers_per_block + 1):
+            res_skip = (skip_base if li == cfg.layers_per_block else cout)
+            res_in = prev if li == 0 else cout
+            resnet(f"up_blocks.{bi}.resnets.{li}", res_in + res_skip,
+                   cout, temb)
+            if btype == "CrossAttnUpBlock2D":
+                t2d(f"up_blocks.{bi}.attentions.{li}", cout,
+                    cfg.cross_attention_dim, cfg.transformer_depth)
+        if bi != len(bo) - 1:
+            conv(f"up_blocks.{bi}.upsamplers.0.conv", cout, cout)
+    norm("conv_norm_out", bo[0])
+    conv("conv_out", bo[0], cfg.out_channels)
+    return sd
+
+
+class TestLoaders:
+    def test_unet_loader_roundtrip(self):
+        cfg = tiny_unet_cfg()
+        sd = synth_unet_sd(cfg)
+        params = load_unet(cfg, sd)
+        unet = UNet2DCondition(cfg)
+        out = unet.apply(params, jnp.ones((1, 8, 8, 4)) * 0.1,
+                         jnp.array([3]), jnp.ones((1, 5, 24)) * 0.1)
+        assert out.shape == (1, 8, 8, 4)
+        assert np.isfinite(np.asarray(out)).all()
+        # the loaded tree matches the init tree structurally
+        ref = jax.tree_util.tree_structure(unet.init(jax.random.PRNGKey(0)))
+        assert jax.tree_util.tree_structure(params) == ref
+
+    def test_unet_loader_rejects_partial_checkpoint(self):
+        cfg = tiny_unet_cfg()
+        sd = synth_unet_sd(cfg)
+        sd.pop("mid_block.resnets.0.conv1.weight")
+        with pytest.raises(KeyError, match="missing"):
+            load_unet(cfg, sd)
+
+    def test_unet_loader_rejects_unconsumed_keys(self):
+        cfg = tiny_unet_cfg()
+        sd = synth_unet_sd(cfg)
+        sd["down_blocks.7.mystery.weight"] = np.zeros(3, np.float32)
+        with pytest.raises(ValueError, match="not consumed"):
+            load_unet(cfg, sd)
+
+    def test_vae_loader_roundtrip_and_legacy_attn(self):
+        cfg = VAEConfig(block_out_channels=(16, 32), layers_per_block=1,
+                        norm_num_groups=8)
+        vae = AutoencoderKL(cfg)
+        ref_params = vae.init(jax.random.PRNGKey(0))
+
+        # synthesize a state dict from the init tree with diffusers names
+        sd = {}
+
+        def put_conv(name, p):
+            sd[f"{name}.weight"] = np.asarray(p["kernel"]).transpose(
+                3, 2, 0, 1)
+            sd[f"{name}.bias"] = np.asarray(p["bias"])
+
+        def put_norm(name, p):
+            sd[f"{name}.weight"] = np.asarray(p["scale"])
+            sd[f"{name}.bias"] = np.asarray(p["bias"])
+
+        def put_lin(name, p):
+            sd[f"{name}.weight"] = np.asarray(p["kernel"]).T
+            sd[f"{name}.bias"] = np.asarray(p["bias"])
+
+        def put_resnet(name, p):
+            put_norm(f"{name}.norm1", p["norm1"])
+            put_conv(f"{name}.conv1", p["conv1"])
+            put_norm(f"{name}.norm2", p["norm2"])
+            put_conv(f"{name}.conv2", p["conv2"])
+            if "conv_shortcut" in p:
+                put_conv(f"{name}.conv_shortcut", p["conv_shortcut"])
+
+        def put_mid(name, p, legacy):
+            put_resnet(f"{name}.resnets.0", p["resnets"][0])
+            put_resnet(f"{name}.resnets.1", p["resnets"][1])
+            a = p["attentions"][0]
+            if legacy:   # pre-refactor diffusers names + 1x1-conv weights
+                put_norm(f"{name}.attentions.0.group_norm",
+                         a["group_norm"])
+                for src, dst in (("to_q", "query"), ("to_k", "key"),
+                                 ("to_v", "value"),
+                                 ("to_out", "proj_attn")):
+                    w = np.asarray(a[src]["kernel"]).T
+                    sd[f"{name}.attentions.0.{dst}.weight"] = \
+                        w[:, :, None, None]
+                    sd[f"{name}.attentions.0.{dst}.bias"] = np.asarray(
+                        a[src]["bias"])
+            else:
+                put_norm(f"{name}.attentions.0.group_norm",
+                         a["group_norm"])
+                for nm in ("to_q", "to_k", "to_v"):
+                    put_lin(f"{name}.attentions.0.{nm}", a[nm])
+                put_lin(f"{name}.attentions.0.to_out.0", a["to_out"])
+
+        enc, dec = ref_params["encoder"], ref_params["decoder"]
+        put_conv("encoder.conv_in", enc["conv_in"])
+        for bi, blk in enumerate(enc["down_blocks"]):
+            for li, rp in enumerate(blk["resnets"]):
+                put_resnet(f"encoder.down_blocks.{bi}.resnets.{li}", rp)
+            if "downsample" in blk:
+                put_conv(f"encoder.down_blocks.{bi}.downsamplers.0.conv",
+                         blk["downsample"])
+        put_mid("encoder.mid_block", enc["mid_block"], legacy=True)
+        put_norm("encoder.conv_norm_out", enc["conv_norm_out"])
+        put_conv("encoder.conv_out", enc["conv_out"])
+        put_conv("decoder.conv_in", dec["conv_in"])
+        put_mid("decoder.mid_block", dec["mid_block"], legacy=False)
+        for bi, blk in enumerate(dec["up_blocks"]):
+            for li, rp in enumerate(blk["resnets"]):
+                put_resnet(f"decoder.up_blocks.{bi}.resnets.{li}", rp)
+            if "upsample" in blk:
+                put_conv(f"decoder.up_blocks.{bi}.upsamplers.0.conv",
+                         blk["upsample"])
+        put_norm("decoder.conv_norm_out", dec["conv_norm_out"])
+        put_conv("decoder.conv_out", dec["conv_out"])
+        put_conv("quant_conv", ref_params["quant_conv"])
+        put_conv("post_quant_conv", ref_params["post_quant_conv"])
+
+        loaded = load_vae(cfg, sd)
+        # loader output bitwise-matches the tree it was synthesized from
+        for (pa, la), (pb, lb) in zip(
+                jax.tree_util.tree_flatten_with_path(ref_params)[0],
+                jax.tree_util.tree_flatten_with_path(loaded)[0]):
+            assert pa == pb
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                       atol=1e-6, err_msg=str(pa))
+        # encode -> decode runs
+        img = jnp.ones((1, 16, 16, 3)) * 0.2
+        mean, _ = vae.encode(loaded, img)
+        out = vae.decode(loaded, mean)
+        assert out.shape == (1, 16, 16, 3)
+
+
+# ---------------------------------------------------------------------------
+# scheduler + pipeline
+# ---------------------------------------------------------------------------
+class TestSchedulerPipeline:
+    def test_ddim_recovers_x0_with_true_noise(self):
+        from deepspeed_tpu.models.diffusion import DDIMConfig
+        s = DDIMScheduler(DDIMConfig(set_alpha_to_one=True))
+        rs = np.random.RandomState(0)
+        x0 = jnp.asarray(rs.randn(1, 4, 4, 4), jnp.float32)
+        eps = jnp.asarray(rs.randn(1, 4, 4, 4), jnp.float32)
+        t = 500
+        a = s.alphas_cumprod[t]
+        noisy = jnp.sqrt(a) * x0 + jnp.sqrt(1 - a) * eps
+        rec = s.step(eps, t, -1, noisy)
+        np.testing.assert_allclose(np.asarray(rec), np.asarray(x0),
+                                   atol=1e-4)
+
+    def test_ddim_sd_config_semantics(self):
+        """SD's shipped scheduler: steps_offset=1 shifts every sampled
+        timestep up by one; the final step targets alphas_cumprod[0]."""
+        s = DDIMScheduler()
+        ts = s.timesteps(50)
+        assert ts[0] == 981 and ts[-1] == 1
+        assert float(s.final_alpha_cumprod) == float(s.alphas_cumprod[0])
+
+    def test_pipeline_deterministic_and_guided(self):
+        cfg = tiny_unet_cfg()
+        vcfg = VAEConfig(block_out_channels=(16, 32), layers_per_block=1,
+                         norm_num_groups=8)
+        ccfg = CLIPTextConfig(vocab_size=64, hidden_size=24,
+                              intermediate_size=48, num_hidden_layers=2,
+                              num_attention_heads=2,
+                              max_position_embeddings=8)
+        unet = UNet2DCondition(cfg)
+        vae = AutoencoderKL(vcfg)
+        clip = CLIPTextEncoder(ccfg)
+        params = {"unet": load_unet(cfg, synth_unet_sd(cfg)),
+                  "vae": vae.init(jax.random.PRNGKey(1)),
+                  "text_encoder": clip.init(jax.random.PRNGKey(2))}
+        pipe = StableDiffusionPipeline(unet, vae, clip)
+        ids = np.array([[1, 2, 3, 4, 5, 6, 7, 8]], np.int32)
+        un = np.zeros_like(ids)
+        a = pipe(params, ids, un, num_steps=3, height=32, width=32,
+                 rng=jax.random.PRNGKey(7))
+        b = pipe(params, ids, un, num_steps=3, height=32, width=32,
+                 rng=jax.random.PRNGKey(7))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert a.shape[0] == 1 and a.shape[-1] == 3
+        assert np.isfinite(np.asarray(a)).all()
+        assert (np.asarray(a) >= 0).all() and (np.asarray(a) <= 1).all()
+        # a different prompt changes the image (cross-attention is live)
+        c = pipe(params, ids * 0 + 9, un, num_steps=3, height=32,
+                 width=32, rng=jax.random.PRNGKey(7))
+        assert np.abs(np.asarray(a) - np.asarray(c)).max() > 1e-6
