@@ -1,4 +1,5 @@
 """Autotuning — counterpart of `/root/reference/deepspeed/autotuning/`."""
 from .autotuner import Autotuner
+from .scheduler import ResourceManager
 
-__all__ = ["Autotuner"]
+__all__ = ["Autotuner", "ResourceManager"]

@@ -200,6 +200,91 @@ class Autotuner:
             best["_model_overrides"] = dict(best_kw)
         return best
 
+    # -- scheduled (subprocess) tuning -------------------------------------
+    def _make_specs(self, seq: Optional[int] = None,
+                    steps: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Job specs for the experiment scheduler: the in-process
+        model-based pruner stays the PROPOSAL stage; measurement moves to
+        isolated subprocesses."""
+        mcfg = getattr(self.model, "config", None)
+        if mcfg is None or not dataclasses.is_dataclass(mcfg):
+            raise ValueError(
+                "scheduled tuning needs a model with a dataclass config "
+                "(serialized into the job spec)")
+        base = dataclasses.asdict(mcfg)
+        for k in ("dtype", "param_dtype"):
+            if k in base and not isinstance(base[k], str):
+                base[k] = np.dtype(base[k]).name   # JSON-safe dtype name
+        specs = []
+        for exp in self.generate_experiments():
+            mc = dict(base)
+            mc.update(exp["model_kw"])
+            specs.append({
+                "cfg": exp["cfg"], "model_config": mc,
+                "steps": steps or self.steps_per_trial,
+                "seq": seq,
+                "meta": {"mb": exp["mb"],
+                         "zero_stage": exp["cfg"]["zero_optimization"]
+                         ["stage"],
+                         "offload": bool(exp["cfg"]["zero_optimization"]
+                                         .get("offload_optimizer")),
+                         **exp["model_kw"]}})
+        return specs
+
+    def tune_scheduled(self, workdir: str, slots: int = 1,
+                       timeout_s: float = 600.0,
+                       env: Optional[Dict[str, str]] = None,
+                       seq: Optional[int] = None,
+                       specs: Optional[List[Dict[str, Any]]] = None
+                       ) -> Dict[str, Any]:
+        """Reference `Autotuner.tune` (`autotuner.py:421`) semantics:
+        experiments run as scheduler jobs with crash/timeout isolation
+        and parallel slots; returns the best config and stores a ranked
+        report in ``self.results`` (+ ``<workdir>/autotune_report.json``).
+        """
+        import json
+        import os
+        from .scheduler import ResourceManager
+        specs = specs if specs is not None else self._make_specs(seq=seq)
+        # smallest micro-batches first: cheap failures surface early
+        order = sorted(range(len(specs)),
+                       key=lambda i: specs[i]["meta"]["mb"])
+        specs = [specs[i] for i in order]
+        logger.info(f"scheduled autotuning: {len(specs)} jobs, "
+                    f"{slots} slots, timeout {timeout_s}s")
+        rm = ResourceManager(slots=slots, timeout_s=timeout_s, env=env)
+        results = rm.run(specs, workdir)
+        self.results = []
+        for spec, res in zip(specs, results):
+            self.results.append({**spec["meta"], "status": res["status"],
+                                 "samples_per_sec": res.get(
+                                     "samples_per_sec"),
+                                 "detail": res.get("detail", "")})
+        ranked = sorted((r for r in self.results
+                         if r["samples_per_sec"] is not None),
+                        key=lambda r: -r["samples_per_sec"])
+        with open(os.path.join(workdir, "autotune_report.json"),
+                  "w") as f:
+            json.dump({"ranked": ranked, "all": self.results}, f,
+                      indent=1)
+        if not ranked:
+            raise RuntimeError(
+                "every scheduled autotuning experiment failed — see "
+                f"{workdir}/autotune_report.json")
+        best_meta = ranked[0]
+        # rebuild the winning engine config from the meta row
+        for spec in specs:
+            if spec["meta"] == {k: best_meta[k] for k in spec["meta"]}:
+                best = copy.deepcopy(spec["cfg"])
+                kw = {k: v for k, v in best_meta.items()
+                      if k not in ("mb", "zero_stage", "offload", "status",
+                                   "samples_per_sec", "detail")}
+                if kw:
+                    best["_model_overrides"] = kw
+                logger.info(f"scheduled autotune best: {best_meta}")
+                return best
+        raise RuntimeError("internal: winning spec not found")
+
     @staticmethod
     def apply_best(model, best_config: Dict[str, Any]):
         """Split tune()'s result into (model, engine_config): model-side
