@@ -2,7 +2,10 @@
 from .elastic_agent import AgentResult, ElasticAgent, WorkerSpec
 from .elasticity import (ElasticityError, ElasticityIncompatibleWorldSize,
                          compute_elastic_config)
+from .rendezvous import (ClusterAgentResult, ClusterElasticAgent,
+                         FileRendezvous)
 
 __all__ = ["AgentResult", "ElasticAgent", "WorkerSpec",
            "compute_elastic_config", "ElasticityError",
-           "ElasticityIncompatibleWorldSize"]
+           "ElasticityIncompatibleWorldSize", "ClusterAgentResult",
+           "ClusterElasticAgent", "FileRendezvous"]

@@ -1,0 +1,319 @@
+"""Cross-node rendezvous + per-node elastic agents.
+
+Role-equivalent of the reference's torch-elastic integration
+(`/root/reference/deepspeed/elasticity/elastic_agent.py:23` DSElasticAgent
+extends ``LocalElasticAgent`` whose ``_invoke_run`` (:115) monitors the
+worker group against a rendezvous store shared by every node): N agents —
+one per node — agree through a store on each *generation*'s membership,
+world size, and rank assignment; any agent can trigger a re-rendezvous
+(local worker death) and dead NODES are excluded by heartbeat staleness.
+
+TPU redesign: the store is a shared directory (TPU pods mount shared
+filesystems; the same protocol runs on GCS-fuse) with atomic
+rename-based writes instead of an etcd/c10d TCP service — no extra
+daemon, and the decision logic (world size from the v0.1/v0.2 batch
+solver, contiguous rank blocks by node id) is explicit in
+``FileRendezvous.decide`` rather than hidden in a store transaction.
+
+Generation protocol:
+  1. every live agent writes   gen_<g>/member_<node>.json {slots}
+  2. after the settle window the lowest-id member writes
+     gen_<g>/decision.json {members, counts, world_size}
+     (any member may write it after a grace period — first rename wins)
+  3. agents launch their assigned workers with RANK/WORLD_SIZE env
+  4. agents heartbeat gen_<g>/hb_<node>; a stale heartbeat or a local
+     worker failure makes an agent write gen_<g>/restart, everyone
+     kills local workers and re-joins at g+1
+  5. an agent whose workers all exit 0 writes gen_<g>/done_<node>; when
+     every member is done the generation (and the run) succeeded
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import logger
+from .elasticity import ElasticityError, compute_elastic_config
+
+
+def _atomic_write(path: str, data: Dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(data, f)
+    try:
+        os.rename(tmp, path)          # first writer wins; losers overwrite
+    except OSError:
+        os.unlink(tmp)
+
+
+class FileRendezvous:
+    """One generation directory per rendezvous round in a shared path."""
+
+    def __init__(self, store_path: str, node_id: str, slots: int,
+                 settle_s: float = 0.6, decide_grace_s: float = 2.0,
+                 hb_interval_s: float = 0.3, hb_timeout_s: float = 2.5):
+        self.root = store_path
+        self.node = str(node_id)
+        self.slots = int(slots)
+        self.settle_s = settle_s
+        self.decide_grace_s = decide_grace_s
+        self.hb_interval_s = hb_interval_s
+        self.hb_timeout_s = hb_timeout_s
+        self._last_hb = 0.0
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- paths -------------------------------------------------------------
+    def _gdir(self, gen: int) -> str:
+        d = os.path.join(self.root, f"gen_{gen}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    # -- membership --------------------------------------------------------
+    def members(self, gen: int) -> Dict[str, int]:
+        out = {}
+        d = self._gdir(gen)
+        for fn in os.listdir(d):
+            if fn.startswith("member_"):
+                try:
+                    with open(os.path.join(d, fn)) as f:
+                        out[fn[len("member_"):-len(".json")]] = \
+                            json.load(f)["slots"]
+                except (OSError, ValueError):
+                    pass                       # mid-write: next poll sees it
+        return out
+
+    def join(self, gen: int, valid_worlds: Sequence[int],
+             timeout_s: float = 60.0) -> Dict:
+        """Announce, settle, decide (or read the decision). Returns
+        {"members": [...], "counts": {node: n_workers},
+        "world_size": W, "offsets": {node: first_rank}}."""
+        d = self._gdir(gen)
+        _atomic_write(os.path.join(d, f"member_{self.node}.json"),
+                      {"slots": self.slots, "ts": time.time()})
+        self.heartbeat(gen)
+        decision_path = os.path.join(d, "decision.json")
+        deadline = time.monotonic() + timeout_s
+        last_count, settled_at = 0, time.monotonic()
+        announced_at = time.monotonic()
+        while time.monotonic() < deadline:
+            self.heartbeat(gen)
+            if os.path.exists(decision_path):
+                with open(decision_path) as f:
+                    return json.load(f)
+            mem = self.members(gen)
+            if len(mem) != last_count:
+                last_count, settled_at = len(mem), time.monotonic()
+            settled = time.monotonic() - settled_at >= self.settle_s
+            leader = sorted(mem) and sorted(mem)[0] == self.node
+            grace = (time.monotonic() - announced_at
+                     >= self.settle_s + self.decide_grace_s)
+            if settled and mem and (leader or grace):
+                # leader decides; after the grace window anyone may (the
+                # leader may have died between announce and decide)
+                dec = self.decide(mem, valid_worlds)
+                if dec is not None:
+                    _atomic_write(decision_path, dec)
+            time.sleep(0.05)
+        raise ElasticityError(
+            f"rendezvous generation {gen} timed out after {timeout_s}s "
+            f"(members seen: {sorted(self.members(gen))})")
+
+    @staticmethod
+    def decide(members: Dict[str, int],
+               valid_worlds: Sequence[int]) -> Optional[Dict]:
+        total = sum(members.values())
+        fits = [w for w in valid_worlds if w <= total]
+        if not fits:
+            return None
+        world = max(fits)
+        counts, offsets, used = {}, {}, 0
+        for node in sorted(members):
+            take = min(members[node], world - used)
+            counts[node] = take
+            offsets[node] = used
+            used += take
+        return {"members": sorted(members), "counts": counts,
+                "offsets": offsets, "world_size": world}
+
+    # -- liveness / signals ------------------------------------------------
+    def heartbeat(self, gen: int) -> None:
+        now = time.monotonic()
+        if now - self._last_hb < self.hb_interval_s:
+            return
+        self._last_hb = now
+        _atomic_write(os.path.join(self._gdir(gen), f"hb_{self.node}"),
+                      {"ts": time.time()})
+
+    def stale_peers(self, gen: int, members: Sequence[str]) -> List[str]:
+        d = self._gdir(gen)
+        out = []
+        for node in members:
+            if node == self.node:
+                continue
+            p = os.path.join(d, f"hb_{node}")
+            try:
+                with open(p) as f:
+                    ts = json.load(f)["ts"]
+            except (OSError, ValueError):
+                ts = 0.0
+            if time.time() - ts > self.hb_timeout_s:
+                out.append(node)
+        return out
+
+    def signal_restart(self, gen: int, reason: str) -> None:
+        _atomic_write(os.path.join(self._gdir(gen), "restart"),
+                      {"by": self.node, "reason": reason})
+
+    def restart_requested(self, gen: int) -> bool:
+        return os.path.exists(os.path.join(self._gdir(gen), "restart"))
+
+    def mark_done(self, gen: int) -> None:
+        _atomic_write(os.path.join(self._gdir(gen), f"done_{self.node}"),
+                      {"ts": time.time()})
+
+    def all_done(self, gen: int, members: Sequence[str]) -> bool:
+        d = self._gdir(gen)
+        return all(os.path.exists(os.path.join(d, f"done_{n}"))
+                   for n in members)
+
+
+@dataclasses.dataclass
+class ClusterAgentResult:
+    success: bool
+    final_world_size: int
+    generations: int
+    local_return_codes: List[int]
+
+
+class ClusterElasticAgent:
+    """One per node. Launches this node's share of each generation's
+    worker group and participates in the rendezvous protocol above.
+
+    Worker env contract (the engine side of the reference's
+    agent-restart + load_checkpoint pairing): RANK / WORLD_SIZE /
+    LOCAL_RANK / ELASTIC_RESTART_COUNT; training scripts are expected to
+    resume from their latest checkpoint when ELASTIC_RESTART_COUNT > 0.
+    """
+
+    def __init__(self, node_id: str, slots: int, argv: Sequence[str],
+                 ds_config: Dict, store_path: str,
+                 env: Optional[Dict[str, str]] = None,
+                 cwd: Optional[str] = None,
+                 monitor_interval: float = 0.1,
+                 max_restarts: int = 5,
+                 rdzv_timeout_s: float = 60.0,
+                 start_generation: int = 1):
+        self.node = str(node_id)
+        self.slots = int(slots)
+        self.argv = list(argv)
+        self.env = dict(env or {})
+        self.cwd = cwd
+        self.monitor_interval = monitor_interval
+        self.max_restarts = max_restarts
+        self.rdzv_timeout_s = rdzv_timeout_s
+        self.generation = start_generation
+        _, self.valid_worlds = compute_elastic_config(ds_config,
+                                                      world_size=0)
+        self.rdzv = FileRendezvous(store_path, self.node, self.slots)
+
+    def _launch_local(self, dec: Dict, gen: int) -> List[subprocess.Popen]:
+        n = dec["counts"].get(self.node, 0)
+        off = dec["offsets"].get(self.node, 0)
+        procs = []
+        for lr in range(n):
+            env = dict(os.environ)
+            env.update(self.env)
+            env.update({"WORLD_SIZE": str(dec["world_size"]),
+                        "RANK": str(off + lr),
+                        "LOCAL_RANK": str(lr),
+                        "ELASTIC_RESTART_COUNT": str(gen - 1),
+                        "DSTPU_ELASTIC_NODE": self.node})
+            procs.append(subprocess.Popen(self.argv, env=env,
+                                          cwd=self.cwd))
+        logger.info(f"cluster agent[{self.node}]: gen {gen} launched "
+                    f"{n}/{dec['world_size']} workers (ranks {off}.."
+                    f"{off + n - 1})")
+        return procs
+
+    @staticmethod
+    def _kill(procs: List[subprocess.Popen]) -> None:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 5
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    def run(self) -> ClusterAgentResult:
+        restarts = 0
+        while True:
+            gen = self.generation
+            dec = self.rdzv.join(gen, self.valid_worlds,
+                                 timeout_s=self.rdzv_timeout_s)
+            if self.node not in dec["members"]:
+                # announced too late for this generation: follow to next
+                self.generation += 1
+                continue
+            procs = self._launch_local(dec, gen)
+            outcome = None          # "done" | "restart"
+            while outcome is None:
+                self.rdzv.heartbeat(gen)
+                codes = [p.poll() for p in procs]
+                if any(c is not None and c != 0 for c in codes):
+                    n_dead = sum(1 for c in codes
+                                 if c is not None and c != 0)
+                    # a failed worker burns its slot (the shrink
+                    # semantics of the node-local agent, kept here)
+                    self.slots = max(0, self.slots - n_dead)
+                    self.rdzv.slots = self.slots
+                    self.rdzv.signal_restart(
+                        gen, f"{self.node}: {n_dead} worker(s) failed")
+                    outcome = "restart"
+                    break
+                if all(c == 0 for c in codes):
+                    self.rdzv.mark_done(gen)
+                    # wait for peers (or a restart signal from them)
+                    if self.rdzv.all_done(gen, dec["members"]):
+                        return ClusterAgentResult(
+                            True, dec["world_size"], gen,
+                            [p.returncode for p in procs])
+                if self.rdzv.restart_requested(gen):
+                    outcome = "restart"
+                    break
+                stale = self.rdzv.stale_peers(gen, dec["members"])
+                if stale:
+                    logger.warning(
+                        f"cluster agent[{self.node}]: peers {stale} "
+                        f"stopped heartbeating — excluding and "
+                        f"re-rendezvousing")
+                    self.rdzv.signal_restart(gen,
+                                             f"stale peers {stale}")
+                    outcome = "restart"
+                    break
+                time.sleep(self.monitor_interval)
+            self._kill(procs)
+            if outcome == "restart":
+                restarts += 1
+                if restarts > self.max_restarts:
+                    return ClusterAgentResult(
+                        False, dec["world_size"], gen,
+                        [p.returncode if p.returncode is not None else -1
+                         for p in procs])
+                if self.slots == 0:
+                    logger.warning(
+                        f"cluster agent[{self.node}]: no slots left — "
+                        f"leaving the job")
+                    return ClusterAgentResult(
+                        False, 0, gen,
+                        [p.returncode if p.returncode is not None else -1
+                         for p in procs])
+                self.generation += 1

@@ -1,0 +1,61 @@
+"""Elastic training worker used by the cluster-agent tests.
+
+Implements the worker side of the elastic contract
+(`deepspeed_tpu/elasticity/rendezvous.py` ClusterElasticAgent): read
+coordinates from env, resume from the latest checkpoint when
+ELASTIC_RESTART_COUNT > 0, train, checkpoint every step, exit 0 when
+the target step count is reached. Deterministic gradient descent on a
+1-D quadratic stands in for the training loop so loss continuity across
+a restart is exactly checkable.
+
+Fault injection: DSTPU_FAIL_RANK + DSTPU_FAIL_GEN + DSTPU_FAIL_STEP make
+that (rank, generation) die at the given step with exit code 13.
+"""
+import json
+import os
+import sys
+import time
+
+
+def main():
+    rank = int(os.environ["RANK"])
+    world = int(os.environ["WORLD_SIZE"])
+    gen = int(os.environ["ELASTIC_RESTART_COUNT"])
+    workdir = os.environ["DSTPU_ELASTIC_WORKDIR"]
+    total_steps = int(os.environ.get("DSTPU_TOTAL_STEPS", "12"))
+    fail_rank = int(os.environ.get("DSTPU_FAIL_RANK", "-1"))
+    fail_gen = int(os.environ.get("DSTPU_FAIL_GEN", "-1"))
+    fail_step = int(os.environ.get("DSTPU_FAIL_STEP", "-1"))
+
+    ckpt = os.path.join(workdir, "ckpt.json")
+    state = {"step": 0, "w": 5.0}
+    if gen > 0 and os.path.exists(ckpt):
+        with open(ckpt) as f:
+            state = json.load(f)
+
+    log = open(os.path.join(workdir, f"loss_rank{rank}_gen{gen}.jsonl"),
+               "a")
+    lr = 0.2
+    while state["step"] < total_steps:
+        if (rank == fail_rank and gen == fail_gen
+                and state["step"] == fail_step):
+            sys.exit(13)
+        # "training": w <- w - lr * dL/dw, L = w^2
+        state["w"] -= lr * 2 * state["w"]
+        state["step"] += 1
+        loss = state["w"] ** 2
+        log.write(json.dumps({"step": state["step"], "loss": loss,
+                              "rank": rank, "world": world,
+                              "gen": gen}) + "\n")
+        log.flush()
+        if rank == 0:
+            tmp = f"{ckpt}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            os.rename(tmp, ckpt)
+        time.sleep(0.08)
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()
