@@ -1,16 +1,20 @@
 """Compression — counterpart of `/root/reference/deepspeed/compression/`."""
 from .compress import (ActivationQuantConfig, CompressionConfig,
-                       HeadPruningConfig, LayerReductionConfig, PruningGroup,
-                       RowPruningConfig, SparsePruningConfig,
-                       WeightQuantizeConfig, apply_layer_reduction,
-                       bits_at_step, compress_params, init_compression,
-                       init_compression_model, parse_compression_config,
-                       post_training_quantize, redundancy_clean, topk_mask)
+                       HeadPruningConfig, LayerReductionConfig,
+                       MovementPruningModel, PruningGroup, RowPruningConfig,
+                       SparsePruningConfig, WeightQuantizeConfig,
+                       add_movement_scores, apply_layer_reduction,
+                       bits_at_step, calibrate_activation_ranges,
+                       compress_params, init_compression,
+                       init_compression_model, movement_mask,
+                       parse_compression_config, post_training_quantize,
+                       redundancy_clean, topk_mask)
 
 __all__ = ["ActivationQuantConfig", "CompressionConfig", "HeadPruningConfig",
-           "LayerReductionConfig", "PruningGroup", "RowPruningConfig",
-           "SparsePruningConfig", "WeightQuantizeConfig",
-           "apply_layer_reduction", "bits_at_step", "compress_params",
-           "init_compression", "init_compression_model",
+           "LayerReductionConfig", "MovementPruningModel", "PruningGroup",
+           "RowPruningConfig", "SparsePruningConfig", "WeightQuantizeConfig",
+           "add_movement_scores", "apply_layer_reduction", "bits_at_step",
+           "calibrate_activation_ranges", "compress_params",
+           "init_compression", "init_compression_model", "movement_mask",
            "parse_compression_config", "post_training_quantize",
            "redundancy_clean", "topk_mask"]

@@ -88,6 +88,10 @@ class ActivationQuantConfig:
     symmetric: bool = False           # reference default asymmetric
     range_calibration: str = "dynamic"
     schedule_offset: int = 0
+    # static calibrated absmax per model seam site (attn_in, mlp_in) —
+    # produced by calibrate_activation_ranges; required when
+    # range_calibration == "static"
+    ranges: Sequence[float] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -149,11 +153,13 @@ def _parse_pruning(block: Dict, cls, ratio_key: str, **extra):
     shared = block.get("shared_parameters", block)
     enabled = bool(shared.get("enabled", False))
     method = shared.get("method", "l1")
-    if enabled and method == "topk":
+    if enabled and method == "topk" and cls is not SparsePruningConfig:
         raise NotImplementedError(
-            f"{cls.__name__}: method='topk' (movement pruning) needs "
-            f"auxiliary trainable mask scores — not built; use method='l1' "
-            f"(magnitude, recomputed per step like the reference's l1 mode)")
+            f"{cls.__name__}: method='topk' (movement pruning) is built "
+            f"for sparse_pruning (per-element trainable scores — the "
+            f"reference's TopKBinarizer scope, compression/utils.py:6); "
+            f"row/head pruning are structural L1 decisions, use "
+            f"method='l1'")
     groups = _parse_groups(block, ratio_key)
     if not groups and "dense_ratio" in shared:
         groups = [PruningGroup(dense_ratio=float(shared["dense_ratio"]),
@@ -220,14 +226,15 @@ def parse_compression_config(d: Dict[str, Any]) -> CompressionConfig:
             symmetric=(sp.get("quantization_type", "asymmetric")
                        == "symmetric"),
             range_calibration=sp.get("range_calibration", "dynamic"),
-            schedule_offset=int(sp.get("schedule_offset", 0)))
+            schedule_offset=int(sp.get("schedule_offset", 0)),
+            ranges=tuple(sp.get("ranges", ())))
     else:
         aq = ActivationQuantConfig(**aq_block)
-    if aq.enabled and aq.range_calibration == "static":
+    if aq.enabled and aq.range_calibration == "static" and not aq.symmetric:
         raise NotImplementedError(
-            "activation_quantization range_calibration='static' needs "
-            "calibration-pass machinery — 'dynamic' (per-tensor, per-step) "
-            "is built")
+            "static activation ranges are symmetric-absmax "
+            "(fake_quantize_static); set quantization_type='symmetric' "
+            "or use dynamic calibration for the asymmetric path")
     if aq.enabled and aq.schedule_offset:
         raise NotImplementedError(
             "activation_quantization schedule_offset is not honored — the "
@@ -294,6 +301,47 @@ def _sparse_mask(w, ratio):
     return topk_mask(jnp.abs(w.astype(jnp.float32)), ratio).astype(w.dtype)
 
 
+def movement_mask(scores, keep_ratio):
+    """Straight-through top-k over TRAINABLE scores (reference
+    TopKBinarizer, `compression/utils.py:6`): forward value is the hard
+    top-k mask of the scores, backward passes the gradient straight to
+    the scores — so ∂L/∂score = ∂L/∂(w·mask) · w, the movement-pruning
+    update (scores grow where keeping the weight helps)."""
+    hard = topk_mask(scores, keep_ratio)          # stop-gradiented
+    return hard + scores - jax.lax.stop_gradient(scores)
+
+
+MASK_SCORES_KEY = "_mask_scores"
+
+
+def add_movement_scores(params, cfg) -> Dict:
+    """Attach trainable mask-score leaves for every kernel a topk sparse
+    group targets. Scores initialize to |w| so step 0 reproduces
+    magnitude pruning; training then moves them. Returns a NEW params
+    dict with a ``_mask_scores`` subtree (path-string -> score array)."""
+    if isinstance(cfg, dict):
+        cfg = parse_compression_config(cfg)
+    sp = cfg.sparse_pruning
+    if not (sp.enabled and sp.method == "topk"):
+        raise ValueError("add_movement_scores: sparse_pruning with "
+                         "method='topk' is not enabled in this config")
+    regexes = [re.compile(g.modules or _DEFAULT_SCOPES["sparse"])
+               for g in sp.groups]
+    scores: Dict[str, jnp.ndarray] = {}
+
+    def visit(path, leaf):
+        name = _path_str(path)
+        if (leaf.ndim >= 2 and name.endswith("kernel")
+                and any(rx.search(name) for rx in regexes)):
+            scores[name] = jnp.abs(leaf).astype(jnp.float32)
+        return leaf
+    jax.tree_util.tree_map_with_path(visit, params)
+    if not scores:
+        raise ValueError("add_movement_scores: no kernel matched the topk "
+                         "sparse_pruning scopes")
+    return {**params, MASK_SCORES_KEY: scores}
+
+
 def _row_mask(w, ratio):
     """Structured: prune OUTPUT features (last axis) by their L1 norm —
     the reference's row pruning on [out, in] torch layouts maps to the
@@ -336,9 +384,15 @@ def _gate(step, offset):
 
 def compress_params(params, cfg, step):
     """Apply every enabled param-side technique at ``step`` (traceable).
-    ``cfg`` — CompressionConfig or legacy WeightQuantizeConfig."""
+    ``cfg`` — CompressionConfig or legacy WeightQuantizeConfig. A
+    ``_mask_scores`` subtree (movement pruning, `add_movement_scores`)
+    is consumed here and stripped from the returned tree."""
     if isinstance(cfg, WeightQuantizeConfig):
         cfg = CompressionConfig(weight_quantization=cfg)
+    scores = None
+    if isinstance(params, dict) and MASK_SCORES_KEY in params:
+        scores = params[MASK_SCORES_KEY]
+        params = {k: v for k, v in params.items() if k != MASK_SCORES_KEY}
     wq = cfg.weight_quantization
     pattern = re.compile(wq.modules) if wq.modules else None
     levels: List[int] = []
@@ -349,16 +403,22 @@ def compress_params(params, cfg, step):
             b //= 2
         levels.append(wq.target_bits)
 
-    prunes = []   # (mask_fn(leaf)->mask, regex, offset)
-    for g in (cfg.sparse_pruning.groups if cfg.sparse_pruning.enabled
-              else ()):
-        prunes.append((lambda w, r=g.dense_ratio: _sparse_mask(w, r),
-                       re.compile(g.modules or _DEFAULT_SCOPES["sparse"]),
-                       cfg.sparse_pruning.schedule_offset))
+    prunes = []   # (mask_fn, regex, offset, uses_scores)
+    sp = cfg.sparse_pruning
+    for g in (sp.groups if sp.enabled else ()):
+        rx = re.compile(g.modules or _DEFAULT_SCOPES["sparse"])
+        if sp.method == "topk":
+            prunes.append(
+                (lambda w, s, r=g.dense_ratio:
+                 movement_mask(s, r).astype(w.dtype),
+                 rx, sp.schedule_offset, True))
+        else:
+            prunes.append((lambda w, r=g.dense_ratio: _sparse_mask(w, r),
+                           rx, sp.schedule_offset, False))
     for g in (cfg.row_pruning.groups if cfg.row_pruning.enabled else ()):
         prunes.append((lambda w, r=g.dense_ratio: _row_mask(w, r),
                        re.compile(g.modules or _DEFAULT_SCOPES["row"]),
-                       cfg.row_pruning.schedule_offset))
+                       cfg.row_pruning.schedule_offset, False))
     if cfg.head_pruning.enabled:
         nh = cfg.head_pruning.num_heads
         if nh <= 0:
@@ -367,7 +427,7 @@ def compress_params(params, cfg, step):
             prunes.append(
                 (lambda w, r=g.dense_ratio: _head_mask(w, r, nh)[:, None],
                  re.compile(g.modules or _DEFAULT_SCOPES["head"]),
-                 cfg.head_pruning.schedule_offset))
+                 cfg.head_pruning.schedule_offset, False))
 
     def transform(path, leaf):
         name = _path_str(path)
@@ -378,10 +438,20 @@ def compress_params(params, cfg, step):
         # per-LAYER decisions (the reference masks each weight matrix),
         # so vmap the mask over it
         stacked = name.startswith("blocks") and leaf.ndim >= 2
-        for mask_fn, rx, offset in prunes:
+        for mask_fn, rx, offset, uses_scores in prunes:
             if rx.search(name):
-                mask = (jax.vmap(mask_fn)(out) if stacked
-                        else mask_fn(out))
+                if uses_scores:
+                    s = (scores or {}).get(name)
+                    if s is None:
+                        raise ValueError(
+                            f"movement pruning: no trainable scores for "
+                            f"'{name}' — call add_movement_scores(params,"
+                            f" cfg) before training")
+                    mask = (jax.vmap(mask_fn)(out, s) if stacked
+                            else mask_fn(out, s))
+                else:
+                    mask = (jax.vmap(mask_fn)(out) if stacked
+                            else mask_fn(out))
                 gate = _gate(step, offset)
                 mask = jnp.where(gate, mask, jnp.ones_like(mask))
                 out = out * mask
@@ -504,9 +574,97 @@ def init_compression_model(model, cfg: CompressionConfig):
         raise NotImplementedError(
             "activation_quantization needs the model's dense-input seam; "
             "only TransformerLM carries it (act_quant_bits)")
+    ranges = ()
+    if aq.range_calibration == "static":
+        if not aq.ranges:
+            raise ValueError(
+                "range_calibration='static' needs calibrated ranges — "
+                "run calibrate_activation_ranges(model, params, batches) "
+                "and put the result in activation_quantization.ranges")
+        if len(aq.ranges) != len(TransformerLM._ACT_SITES):
+            raise ValueError(
+                f"activation_quantization.ranges must carry one absmax "
+                f"per seam site {TransformerLM._ACT_SITES}")
+        ranges = tuple(float(r) for r in aq.ranges)
     new_cfg = dc.replace(model.config, act_quant_bits=aq.bits,
-                         act_quant_symmetric=aq.symmetric)
+                         act_quant_symmetric=aq.symmetric,
+                         act_quant_ranges=ranges)
     return TransformerLM(new_cfg, constrain=model.constrain)
+
+
+def calibrate_activation_ranges(model, params, batches) -> tuple:
+    """Static-range calibration pass (the machinery the reference's
+    range_calibration='static' mode assumes): run the model's blocks
+    EAGERLY over calibration batches with the act-quant seam in record
+    mode, returning per-site absmax ordered as ``_ACT_SITES``
+    (attn_in, mlp_in). Eager per-layer walk — lax.scan/remat would trace
+    the seam and hide the values."""
+    import dataclasses as dc
+
+    import numpy as np
+
+    from ..models.transformer import TransformerLM
+    if not isinstance(model, TransformerLM):
+        raise NotImplementedError(
+            "calibration needs TransformerLM's seam sites")
+    calib_model = TransformerLM(dc.replace(model.config, act_quant_bits=0,
+                                           act_quant_ranges=()),
+                                constrain=model.constrain)
+    calib_model._act_calib = {}
+    c = calib_model.config
+    for batch in batches:
+        ids = jnp.asarray(np.asarray(batch["input_ids"]))
+        x = calib_model._embed_tokens(params, ids)
+        for i in range(c.scan_length):
+            lp = jax.tree_util.tree_map(lambda l, i=i: l[i],
+                                        params["blocks"])
+            x, _, _ = calib_model._superblock(lp, x, None, None, None,
+                                              False)
+    calib = calib_model._act_calib
+    del calib_model._act_calib
+    return tuple(calib.get(site, 0.0)
+                 for site in TransformerLM._ACT_SITES)
+
+
+class MovementPruningModel:
+    """Engine-facing wrapper for movement (topk) pruning: ``init`` carries
+    the trainable mask scores (`add_movement_scores`), ``loss`` trains
+    through the straight-through masks, and ``partition_specs`` gives each
+    score leaf ITS kernel's spec so TP shardings survive. Pass to
+    ds.initialize like any model — the scores are ordinary trainable
+    leaves the optimizer updates (the reference trains TopKBinarizer
+    mask_scores the same way)."""
+
+    def __init__(self, model, compression_config):
+        cfg = (compression_config
+               if isinstance(compression_config, CompressionConfig)
+               else parse_compression_config(compression_config))
+        self.cfg = cfg
+        self._inner = init_compression_model(model, cfg)
+
+    def init(self, rng):
+        return add_movement_scores(self._inner.init(rng), self.cfg)
+
+    def loss(self, params, batch, step=0):
+        return self._inner.loss(compress_params(params, self.cfg, step),
+                                batch)
+
+    def partition_specs(self, params=None):
+        inner = self._inner.partition_specs()
+
+        def lookup(name):
+            node = inner
+            for part in name.split("/"):
+                node = (node[int(part)] if isinstance(node, (list, tuple))
+                        else node[part])
+            return node
+        shapes = jax.eval_shape(self.init, jax.random.PRNGKey(0))
+        score_specs = {name: lookup(name)
+                       for name in shapes[MASK_SCORES_KEY]}
+        return {**inner, MASK_SCORES_KEY: score_specs}
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
 
 
 def post_training_quantize(params, cfg):

@@ -89,6 +89,11 @@ class TransformerConfig:
     # fc_in projections with STE. 0 = off.
     act_quant_bits: int = 0
     act_quant_symmetric: bool = False
+    # static calibrated ranges (attn_in, mlp_in absmax) — empty = dynamic
+    # per-tensor ranges. Per-SITE, shared across layers: the scanned block
+    # compiles once for every layer, so per-layer ranges would need a
+    # params seam (see compression.calibrate_activation_ranges).
+    act_quant_ranges: tuple = ()
     layernorm_eps: float = 1e-5
     # Chunked cross-entropy: the [B,T,V] logits tensor is the largest HBM
     # object at vocab 50k; computing the loss in sequence chunks of this many
@@ -399,12 +404,29 @@ class TransformerLM:
                 else L.rmsnorm_apply)
         return partial(base, eps=c.layernorm_eps)
 
-    def _maybe_qact(self, x):
+    _ACT_SITES = ("attn_in", "mlp_in")
+
+    def _maybe_qact(self, x, site: str = "attn_in"):
         """Activation-quantization seam (compression subsystem): STE
-        fake-quant on dense-projection inputs when act_quant_bits is set."""
+        fake-quant on dense-projection inputs when act_quant_bits is set.
+        ``act_quant_ranges`` switches to STATIC calibrated absmax ranges
+        (one per site, ordered as ``_ACT_SITES``); an ``_act_calib`` dict
+        set on the instance makes this seam RECORD absmax instead
+        (eager-mode calibration pass, compression subsystem)."""
         c = self.config
+        calib = getattr(self, "_act_calib", None)
+        if calib is not None:
+            calib[site] = max(calib.get(site, 0.0),
+                              float(jnp.max(jnp.abs(
+                                  x.astype(jnp.float32)))))
+            return x
         if not c.act_quant_bits:
             return x
+        if c.act_quant_ranges:
+            from ..ops.quantizer.quantizer import fake_quantize_static
+            absmax = c.act_quant_ranges[self._ACT_SITES.index(site)]
+            return fake_quantize_static(x, float(absmax),
+                                        c.act_quant_bits)
         from ..ops.quantizer.quantizer import fake_quantize
         return fake_quantize(x, c.act_quant_bits, 1, c.act_quant_symmetric)
 
@@ -413,7 +435,7 @@ class TransformerLM:
         c = self.config
         nh, hd = c.num_heads, c.hdim
         nkv = c.kv_heads
-        qkv = L.dense_apply(p["qkv"], self._maybe_qact(x))
+        qkv = L.dense_apply(p["qkv"], self._maybe_qact(x, "attn_in"))
         b, t = qkv.shape[0], qkv.shape[1]
         if nkv == nh:
             qkv3 = qkv.reshape(b, t, 3, nh, hd)
@@ -544,7 +566,7 @@ class TransformerLM:
         return L.dense_apply(p["out"], o), new_cache
 
     def _mlp(self, p, x):
-        xq = self._maybe_qact(x)
+        xq = self._maybe_qact(x, "mlp_in")
         if self.config.gated_mlp:
             g = L.ACT_FNS[self.config.activation](
                 L.dense_apply(p["fc_gate"], xq))

@@ -78,6 +78,31 @@ def _fq_bwd(num_bits, num_groups, symmetric, _res, g):
 fake_quantize.defvjp(_fq_fwd, _fq_bwd)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def fake_quantize_static(x: jnp.ndarray, absmax: float,
+                         num_bits: int = 8) -> jnp.ndarray:
+    """Symmetric fake-quant against a CALIBRATED static absmax (the
+    reference's static range_calibration: ranges collected offline, baked
+    as compile-time constants — no per-step max reduction in the graph).
+    Values beyond the calibrated range clip; the gradient is
+    straight-through (matching `fake_quantize`)."""
+    levels = 2.0 ** (num_bits - 1) - 1
+    scale = max(absmax, 1e-8) / levels
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -levels, levels)
+    return (q * scale).astype(x.dtype)
+
+
+def _fqs_fwd(x, absmax, num_bits):
+    return fake_quantize_static(x, absmax, num_bits), None
+
+
+def _fqs_bwd(absmax, num_bits, _res, g):
+    return (g,)
+
+
+fake_quantize_static.defvjp(_fqs_fwd, _fqs_bwd)
+
+
 def quantization_error(x: jnp.ndarray, num_bits: int = 8,
                        num_groups: int = 1, symmetric: bool = True
                        ) -> jnp.ndarray:

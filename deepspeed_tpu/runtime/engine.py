@@ -510,10 +510,6 @@ class DeepSpeedEngine:
         axis = opt.comm_axis
         gas = self.gradient_accumulation_steps
         w = self.mesh.shape.get(axis, 1)
-        if self.fp16_enabled:
-            raise NotImplementedError(
-                "1-bit optimizers with fp16 loss scaling are not wired; "
-                "use bf16")
         if self._config.gradient_clipping:
             logger.warning(
                 "gradient_clipping is ignored by the 1-bit optimizer "
@@ -541,9 +537,23 @@ class DeepSpeedEngine:
             apply_fn, uses_errors = programs[key]
 
             def core(state, errors, batch):
+                # fp16 x 1-bit (reference fp16/onebit/adam.py under
+                # FP16_Optimizer): scale the loss, unscale the local
+                # grads, skip-on-overflow EVERYWHERE (the apply is a
+                # collective, so overflow anywhere must skip all
+                # replicas), advance the loss-scale state machine.
+                scale = self._current_scale(state)
                 gsum, lsum = self._accumulate_micro_grads(
-                    state, batch, jnp.asarray(1.0, jnp.float32))
-                grads = jax.tree_util.tree_map(lambda g: g / gas, gsum)
+                    state, batch, scale)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32) / (gas * scale), gsum)
+                if self.loss_scaler is not None and \
+                        self.loss_scaler.detect_overflow:
+                    local_over = DynamicLossScaler.has_overflow(grads)
+                    overflow = jax.lax.pmax(
+                        local_over.astype(jnp.int32), axis) > 0
+                else:
+                    overflow = jnp.asarray(False)
                 lr = self.lr_schedule(state["step"])
                 if uses_errors:
                     new_params, new_opt, new_errors = apply_fn(
@@ -552,10 +562,22 @@ class DeepSpeedEngine:
                     new_params, new_opt = apply_fn(
                         grads, state["opt"], state["params"], lr)
                     new_errors = errors
-                new_state = {"step": state["step"] + 1,
-                             "skipped": state["skipped"],
+
+                def select(new, old):
+                    return jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(overflow, o, n), new, old)
+                new_params = select(new_params, state["params"])
+                new_opt = select(new_opt, state["opt"])
+                new_errors = select(new_errors, errors)
+                new_state = {"step": state["step"]
+                             + jnp.where(overflow, 0, 1),
+                             "skipped": state["skipped"]
+                             + overflow.astype(jnp.int32),
                              "params": new_params, "opt": new_opt}
-                loss = jax.lax.pmean(lsum, axis) / gas
+                if self.loss_scaler is not None:
+                    new_state["scaler"] = self.loss_scaler.update(
+                        state["scaler"], overflow)
+                loss = jax.lax.pmean(lsum, axis) / (gas * scale)
                 # observability must not reintroduce the traffic 1-bit
                 # removes: report the mean of per-replica local norms (one
                 # scalar on the wire) — an upper bound on the norm of the
@@ -563,8 +585,8 @@ class DeepSpeedEngine:
                 gnorm = jax.lax.pmean(global_norm(grads), axis)
                 return new_state, new_errors, {
                     "loss": loss, "grad_norm": gnorm, "lr": lr,
-                    "overflow": jnp.zeros((), jnp.int32),
-                    "loss_scale": jnp.asarray(1.0, jnp.float32)}
+                    "overflow": overflow.astype(jnp.int32),
+                    "loss_scale": scale}
 
             state_specs = jax.tree_util.tree_map(lambda _: P(),
                                                  self.state_specs())

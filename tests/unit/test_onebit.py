@@ -145,16 +145,48 @@ class TestOnebitAdamEngine:
             {"dcn_data": 2, "data": 4}, n=10)
         assert abs(ob[-1] - ref[-1]) / ref[-1] < 0.02, (ref[-1], ob[-1])
 
-    def test_fp16_rejected(self):
-        with pytest.raises(NotImplementedError, match="bf16"):
-            engine, _, _, _ = ds.initialize(model=tiny_model(), config={
-                "train_batch_size": 32, "gradient_accumulation_steps": 2,
-                "optimizer": {"type": "OnebitAdam",
-                              "params": {"lr": 1e-3}},
-                "fp16": {"enabled": True},
-                "mesh": {"dcn_data": 2, "data": 4},
-                "steps_per_print": 0})
-            engine.train_step(batch(32))
+    def test_fp16_loss_scaled_trains(self):
+        """fp16 x 1-bit (reference fp16/onebit/adam.py under
+        FP16_Optimizer): loss-scaled grads, skip-on-overflow, and the
+        compression phase still trains. r3 reject replaced."""
+        engine, _, _, _ = ds.initialize(model=tiny_model(), config={
+            "train_batch_size": 32, "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "OnebitAdam",
+                          "params": {"lr": 1e-3, "freeze_step": 2}},
+            "fp16": {"enabled": True},
+            "mesh": {"dcn_data": 2, "data": 4},
+            "steps_per_print": 0}, rng=jax.random.PRNGKey(0))
+        losses, scales = [], []
+        for i in range(8):
+            m = engine.train_step(batch(32, seed=i))
+            losses.append(float(m["loss"]))
+            scales.append(float(m["loss_scale"]))
+        assert all(np.isfinite(losses))
+        assert engine._onebit_key == "compress"
+        assert losses[-1] < losses[0] + 0.05
+        assert all(s > 1.0 for s in scales)          # scaling was live
+
+    def test_fp16_overflow_skips_and_rescales(self):
+        """An absurd initial scale overflows fp16 grads: the step is
+        skipped (params untouched), the scale halves until training
+        proceeds — the FP16_Optimizer contract under 1-bit."""
+        engine, _, _, _ = ds.initialize(model=tiny_model(), config={
+            "train_batch_size": 32, "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "OnebitAdam",
+                          "params": {"lr": 1e-3, "freeze_step": 100}},
+            "fp16": {"enabled": True,
+                     "initial_scale_power": 40},
+            "mesh": {"dcn_data": 2, "data": 4},
+            "steps_per_print": 0}, rng=jax.random.PRNGKey(0))
+        overflows = 0
+        for i in range(8):
+            m = engine.train_step(batch(32, seed=i))
+            overflows += int(m["overflow"])
+        assert overflows >= 1                         # skips happened
+        assert int(engine.state["skipped"]) == overflows
+        assert float(engine.state["scaler"].scale) < 2.0 ** 40
+        assert np.isfinite(float(m["loss"] if not int(m["overflow"])
+                                 else 0.0))
 
 
 class TestZeroOneSchedule:
