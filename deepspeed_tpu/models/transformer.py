@@ -650,6 +650,19 @@ class TransformerLM:
         c = self.config
         if c.remat == "none":
             return fn
+        if c.remat == "host_offload":
+            # Host (CPU) activation checkpointing (reference
+            # activation_checkpointing/checkpointing.py:485
+            # cpu_checkpointing): the per-layer residual stream spills to
+            # pinned host DRAM between forward and backward instead of
+            # living in HBM — XLA memories do the async transfers the
+            # reference hand-rolled with pinned buffers + streams.
+            # Everything else recomputes (full-remat semantics).
+            policy = jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=["block_in"],
+                offload_src="device", offload_dst="pinned_host")
+            return jax.checkpoint(fn, policy=policy)
         policy = {
             "full": None,
             "dots_saveable": jax.checkpoint_policies.dots_saveable,
@@ -749,6 +762,11 @@ class TransformerLM:
                                token_type_ids=token_type_ids)
 
         def sb_fn(sp, x, key):
+            if c.remat == "host_offload":
+                # name the per-layer residual stream so the offload remat
+                # policy can spill it to host DRAM between fwd and bwd
+                from jax.ad_checkpoint import checkpoint_name
+                x = checkpoint_name(x, "block_in")
             y, _, la = self._superblock(sp, x, None, None, key, train)
             return y, la
         sb = self._remat(sb_fn)
