@@ -287,9 +287,10 @@ class InfinityStepper:
             raise NotImplementedError(
                 "ZeRO-Infinity requires bf16 (fp16 loss scaling is not "
                 "wired into the streamed step); set bf16.enabled")
-        if getattr(model.config, "moe_enabled", False):
-            raise NotImplementedError(
-                "ZeRO-Infinity with MoE expert streaming is not built yet")
+        # MoE composes: expert params stream inside the superblock's flat
+        # vector like dense params (the reference trains MoE under
+        # ZeRO-Offload the same way); only the expert-parallel MESH axis
+        # is rejected above (dp-only composition).
         oc = cfg.optimizer
         name = (oc.type if oc is not None else "adamw").lower()
         if name not in ("adam", "adamw", "fusedadam", "cpuadam",
@@ -529,10 +530,17 @@ class InfinityStepper:
                 cast_res(res), ids,
                 token_type_ids=(tt if c.token_type_vocab else None))
 
+        # MoE: the load-balance aux loss contributes aux_coef * Σ l_aux
+        # to the training loss; its gradient rides the SAME per-layer vjp
+        # (cotangent aux_coef on the l_aux output) so gating weights
+        # train correctly under streaming
+        aux_coef = (float(getattr(c, "moe_aux_loss_coef", 0.0))
+                    if getattr(c, "moe_enabled", False) else 0.0)
+
         def block_fwd(flat, x):
             lp = self._unflatten(flat)
-            y, _, _ = model._superblock(lp, x, None, None, None, True)
-            return y
+            y, _, laux = model._superblock(lp, x, None, None, None, True)
+            return y, jnp.asarray(laux, jnp.float32)
 
         def head_loss(res, xL, ids, labels, mask):
             # mirrors model.loss's label/mask/chunk semantics
@@ -586,9 +594,9 @@ class InfinityStepper:
             return loss, grads[0], grads[1]
 
         def block_vjp(flat, x, dy):
-            y, vjp = jax.vjp(block_fwd, flat, x)
-            del y
-            dflat, dx = vjp(dy)
+            (y, laux), vjp = jax.vjp(block_fwd, flat, x)
+            del y, laux
+            dflat, dx = vjp((dy, jnp.asarray(aux_coef, jnp.float32)))
             sq = jnp.sum(jnp.square(dflat.astype(jnp.float32)))
             return dflat, dx, sq
 
@@ -611,8 +619,8 @@ class InfinityStepper:
             progs = dict(
                 embed_fwd=jax.jit(embed_fwd,
                                   out_shardings=self._batch_shard),
-                block_fwd=jax.jit(block_fwd,
-                                  out_shardings=self._batch_shard),
+                block_fwd=jax.jit(block_fwd, out_shardings=(
+                    self._batch_shard, self._repl)),
                 head_vjp=jax.jit(head_vjp, out_shardings=(
                     self._repl, self._repl, self._batch_shard)),
                 block_vjp=jax.jit(block_vjp, out_shardings=(
@@ -663,18 +671,21 @@ class InfinityStepper:
                 reshape_like(tt) if tt is not None else None)
 
     def _forward_stream(self, progs, ids_dev, tt_dev, stash: bool = True):
-        """Streamed forward → (activation stash | None, final hidden)."""
+        """Streamed forward → (activation stash | None, final hidden,
+        Σ moe aux loss)."""
         L = self.L
         x = progs["embed_fwd"](self.resident, ids_dev, tt_dev)
         acts: List[Any] = [None] * L if stash else None
+        aux = jnp.zeros((), jnp.float32)
         self._ensure_layer(0, {0})
         for i in range(L):
             if i + 1 < L:
                 self._ensure_layer(i + 1, {i, i + 1})
             if stash:
                 acts[i] = x
-            x = progs["block_fwd"](self._dev[i], x)
-        return acts, x
+            x, la = progs["block_fwd"](self._dev[i], x)
+            aux = aux + la
+        return acts, x, aux
 
     def _tt_dev(self, tt, ids):
         """Token-type ids on device. Models without a type vocab get a
@@ -699,9 +710,11 @@ class InfinityStepper:
                     if mask is not None
                     else jnp.zeros((1, 1), jnp.float32))
         tt_dev = self._tt_dev(tt, ids)
-        acts, xL = self._forward_stream(progs, ids_dev, tt_dev)
+        acts, xL, aux = self._forward_stream(progs, ids_dev, tt_dev)
         loss, d_res_head, dy = progs["head_vjp"](
             self.resident, xL, ids_dev, labels_dev, mask_dev)
+        if getattr(self.model.config, "moe_enabled", False):
+            loss = loss + self.model.config.moe_aux_loss_coef * aux
         sqs = []
         for i in reversed(range(self.L)):
             if i - 1 >= 0:
@@ -942,7 +955,8 @@ class InfinityStepper:
         ids_dev = jax.device_put(ids, self._batch_shard)
         zero_i = jnp.zeros((1, 1), jnp.int32)
         tt_dev = self._tt_dev(batch.get("token_type_ids"), ids)
-        _, xL = self._forward_stream(progs, ids_dev, tt_dev, stash=False)
+        _, xL, aux = self._forward_stream(progs, ids_dev, tt_dev,
+                                          stash=False)
         out = float(progs["eval_loss"](
             self.resident, xL, ids_dev,
             jax.device_put(np.asarray(labels), self._batch_shard)
@@ -950,6 +964,8 @@ class InfinityStepper:
             jax.device_put(np.asarray(mask, np.float32), self._batch_shard)
             if mask is not None
             else jnp.zeros((1, 1), jnp.float32)))
+        if getattr(self.model.config, "moe_enabled", False):
+            out += float(self.model.config.moe_aux_loss_coef * aux)
         self._sweep_uploads(block=True)
         return out
 

