@@ -40,13 +40,10 @@ class InferenceEngine:
                  params: Any = None, mesh: Optional[Mesh] = None):
         self.config = config or DeepSpeedInferenceConfig()
         self.dtype = self.config.compute_dtype()
-        if self.config.quant.enabled and \
-                self.config.tensor_parallel.enabled and \
-                self.config.tensor_parallel.tp_size > 1:
-            raise NotImplementedError(
-                "int8 weight-only serving with tensor parallelism is not "
-                "built (quant groups would need TP-aware slicing) — "
-                "drop tp_size to 1 or disable quant")
+        # int8 x TP composes: TP serving switches the quantizer to
+        # per-output-channel scales (see _quantize_weights) whose scale
+        # vector shards exactly like the kernel's last axis — no quant
+        # group ever crosses a shard boundary.
 
         # kernel injection: on a TransformerLM this toggles the Pallas
         # flash/decode attention path (the reference swaps in fused CUDA
@@ -153,6 +150,16 @@ class InferenceEngine:
         self._qshapes = jax.tree_util.tree_map(lambda l: tuple(l.shape),
                                                tmpl)
 
+        tp_live = (self.config.tensor_parallel.enabled
+                   and self.config.tensor_parallel.tp_size > 1)
+        # grouped scales reshape the flat weight to [G, -1]: groups cross
+        # TP shard boundaries, so TP serving uses per-output-CHANNEL
+        # scales instead (reference GroupQuantizer slices groups per TP
+        # rank, replace_module.py:150; per-channel is the partition-free
+        # re-expression — the scale vector shards like the kernel's last
+        # axis and dequant stays shard-local)
+        self._qmode = "channel" if tp_live else "group"
+
         def g_of(leaf_shape):
             # largest divisor of n at or under n/2048: group count must
             # divide the element count (quantize reshapes to [G, -1])
@@ -163,9 +170,18 @@ class InferenceEngine:
                     return g
             return 1
 
+        levels = float(2 ** (bits - 1) - 1)
+
         def qz(l, f):
             if not f:
                 return l, jnp.zeros((0, 1), jnp.float32)
+            if self._qmode == "channel":
+                a = jnp.max(jnp.abs(l.astype(jnp.float32)),
+                            axis=tuple(range(l.ndim - 1)))
+                s = jnp.where(a > 0, a / levels, 1.0)
+                q = jnp.clip(jnp.round(l.astype(jnp.float32) / s),
+                             -levels, levels)
+                return q.astype(jnp.int8), s.astype(jnp.float32)
             q, s, _ = quantize(l, bits, g_of(l.shape), True)
             return q.astype(jnp.int8), s
 
@@ -191,6 +207,10 @@ class InferenceEngine:
         def dq(q, s, f, sh):
             if not f:
                 return q
+            if self._qmode == "channel":
+                # per-output-channel: broadcast multiply on the last axis,
+                # shard-local under TP
+                return (q.astype(jnp.float32) * s).astype(self.dtype)
             return dequantize(q, s, None, sh, self.dtype)
         return jax.tree_util.tree_map(dq, params, scales, self._qflags,
                                       self._qshapes)
