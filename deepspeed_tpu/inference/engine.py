@@ -122,13 +122,14 @@ class InferenceEngine:
     # int8 weight-only
     # ------------------------------------------------------------------
     def _quantize_weights(self) -> None:
-        """Matrix leaves → int8 + per-group fp32 scales, kept as parallel
-        trees; compiled programs dequantize on entry (XLA fuses the scale
-        multiply into the consumer). Weights at REST cost 1 byte/param;
-        note the transient cost: while a compiled program runs, the
-        dequantized compute-dtype copy is live too (~3 bytes/param peak
-        during generate) — per-layer dequant inside the model's scan would
-        bound that to one layer and is not built yet."""
+        """Matrix leaves → int8 + fp32 scales, kept as parallel trees.
+        The ``blocks`` subtree (the bulk of the weights) quantizes
+        PER-LAYER and dequantizes inside the model's scan body via the
+        ``block_transform`` seam — the live full-precision set is ONE
+        layer, not the tree (the role of the reference's per-gemm
+        dequant, `csrc/transformer/inference/csrc/dequantize.cu`).
+        Non-block leaves (with the default scope: nothing — embeddings/
+        heads are excluded) dequantize on program entry."""
         from ..ops.quantizer.quantizer import quantize
         bits = self.config.quant.bits or 8
         tmpl = jax.device_get(jax.tree_util.tree_map(
@@ -147,8 +148,12 @@ class InferenceEngine:
                     and jnp.issubdtype(l.dtype, jnp.floating)
                     and root not in skip_roots)
         self._qflags = jax.tree_util.tree_map_with_path(flag, tmpl)
-        self._qshapes = jax.tree_util.tree_map(lambda l: tuple(l.shape),
-                                               tmpl)
+        # logical matrix shape per leaf: block leaves record the PER-LAYER
+        # slice shape (the unit the scan body dequantizes)
+        self._qshapes = jax.tree_util.tree_map_with_path(
+            lambda p, l: (tuple(l.shape[1:])
+                          if p and str(p[0].key) == "blocks"
+                          else tuple(l.shape)), tmpl)
 
         tp_live = (self.config.tensor_parallel.enabled
                    and self.config.tensor_parallel.tp_size > 1)
@@ -172,7 +177,9 @@ class InferenceEngine:
 
         levels = float(2 ** (bits - 1) - 1)
 
-        def qz(l, f):
+        def qz_one(l, f, shape):
+            """Quantize one logical matrix of ``shape`` (the per-layer
+            slice for stacked block leaves)."""
             if not f:
                 return l, jnp.zeros((0, 1), jnp.float32)
             if self._qmode == "channel":
@@ -182,11 +189,18 @@ class InferenceEngine:
                 q = jnp.clip(jnp.round(l.astype(jnp.float32) / s),
                              -levels, levels)
                 return q.astype(jnp.int8), s.astype(jnp.float32)
-            q, s, _ = quantize(l, bits, g_of(l.shape), True)
+            q, s, _ = quantize(l, bits, g_of(shape), True)
             return q.astype(jnp.int8), s
 
+        def qz(path, l, f):
+            if path and str(path[0].key) == "blocks":
+                # stacked [L, ...]: per-layer quantization so the scan
+                # body can dequantize its own slice
+                return jax.vmap(lambda w: qz_one(w, f, l.shape[1:]))(l)
+            return qz_one(l, f, l.shape)
+
         with self.mesh:
-            pairs = jax.jit(lambda p: jax.tree_util.tree_map(
+            pairs = jax.jit(lambda p: jax.tree_util.tree_map_with_path(
                 qz, p, self._qflags,
                 is_leaf=lambda x: isinstance(x, jax.Array)))(self.params)
         tup = lambda t: isinstance(t, tuple)  # noqa: E731
@@ -195,31 +209,43 @@ class InferenceEngine:
         self._scales = jax.tree_util.tree_map(lambda t: t[1], pairs,
                                               is_leaf=tup)
         self._quantized = True
+        # per-layer dequant rides the model's scan-body seam
+        self.module.block_transform = self._block_dequant
         q_bytes = sum(l.nbytes for l in jax.tree_util.tree_leaves(
             self.params))
         logger.info(f"int8 weight-only serving: params now "
                     f"{q_bytes / 2**20:.1f} MiB on device "
                     f"(bits={bits})")
 
-    def _dequant(self, params, scales):
+    def _dequant_leaf(self, q, s, f, sh):
+        if not f:
+            return q
+        if self._qmode == "channel":
+            # per-output-channel: broadcast multiply on the last axis,
+            # shard-local under TP
+            return (q.astype(jnp.float32) * s).astype(self.dtype)
         from ..ops.quantizer.quantizer import dequantize
+        return dequantize(q, s, None, sh, self.dtype)
 
-        def dq(q, s, f, sh):
-            if not f:
-                return q
-            if self._qmode == "channel":
-                # per-output-channel: broadcast multiply on the last axis,
-                # shard-local under TP
-                return (q.astype(jnp.float32) * s).astype(self.dtype)
-            return dequantize(q, s, None, sh, self.dtype)
-        return jax.tree_util.tree_map(dq, params, scales, self._qflags,
-                                      self._qshapes)
+    def _block_dequant(self, sl):
+        """block_transform seam: one layer's {q, s} slice → standard
+        block tree (full precision lives for one scan iteration)."""
+        return jax.tree_util.tree_map(self._dequant_leaf, sl["q"],
+                                      sl["s"], self._qflags["blocks"],
+                                      self._qshapes["blocks"])
 
     def _model_params(self, params, scales=None):
-        """What compiled programs call to get model-consumable params."""
-        if self._quantized:
-            return self._dequant(params, scales)
-        return params
+        """What compiled programs call to get model-consumable params:
+        non-block leaves dequantize here (default scope: none — they are
+        excluded), block leaves stay int8 and ride into the scan as
+        {q, s} for per-layer dequant via block_transform."""
+        if not self._quantized:
+            return params
+        out = {k: jax.tree_util.tree_map(
+            self._dequant_leaf, v, scales[k], self._qflags[k],
+            self._qshapes[k]) for k, v in params.items() if k != "blocks"}
+        out["blocks"] = {"q": params["blocks"], "s": scales["blocks"]}
+        return out
 
     def _load_checkpoint(self, ckpt_dir: str, tag, shapes, shardings):
         """Restore the params subtree of a training checkpoint, resharded

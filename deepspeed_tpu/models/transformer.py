@@ -217,9 +217,18 @@ class TransformerLM:
     """
 
     def __init__(self, config: TransformerConfig,
-                 constrain: Optional[Callable] = None):
+                 constrain: Optional[Callable] = None,
+                 block_transform: Optional[Callable] = None):
         self.config = config
         self.constrain = constrain or (lambda x: x)
+        # per-layer param hook applied INSIDE the scan body to each
+        # layer's slice of params["blocks"] before use — the seam that
+        # lets int8 serving dequantize one layer at a time (live set =
+        # one full-precision layer, not the whole tree; the role of the
+        # reference's per-gemm dequant, csrc/.../dequantize.cu). The
+        # params tree may then hold any structure block_transform maps
+        # to the standard block tree.
+        self.block_transform = block_transform or (lambda sp: sp)
         self.mesh = None          # bound by the engine (ring attention)
         if config.pos_embedding == "rotary":
             self._cos, self._sin = L.rotary_freqs(
@@ -699,6 +708,7 @@ class TransformerLM:
             # cache leaves: [scan, A, B, T, H, Dh], A = attns per superblock
             def scan_fn(carry, xs):
                 sp, ck, cv = xs
+                sp = self.block_transform(sp)
                 caches = tuple((ck[i], cv[i], idx)
                                for i in range(c.attn_per_block))
                 y, ncs, _ = self._superblock(sp, carry, caches, positions,
@@ -709,6 +719,7 @@ class TransformerLM:
         else:
             def scan_fn(carry, xs):
                 bp, ck, cv = xs
+                bp = self.block_transform(bp)
                 y, kv = self._block(bp, carry, (ck, cv, idx), positions)
                 return y, kv
         x, (nk, nv) = jax.lax.scan(scan_fn, x,
@@ -767,6 +778,7 @@ class TransformerLM:
                 # policy can spill it to host DRAM between fwd and bwd
                 from jax.ad_checkpoint import checkpoint_name
                 x = checkpoint_name(x, "block_in")
+            sp = self.block_transform(sp)
             y, _, la = self._superblock(sp, x, None, None, key, train)
             return y, la
         sb = self._remat(sb_fn)

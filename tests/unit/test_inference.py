@@ -407,7 +407,9 @@ class TestInt8Serving:
         assert eng._qmode == "channel"
         k = eng.params["blocks"]["mlp"]["fc_in"]["kernel"]
         s = eng._scales["blocks"]["mlp"]["fc_in"]["kernel"]
-        assert s.shape == (k.shape[-1],)
+        # stacked block leaves quantize per LAYER (scan-body dequant):
+        # one channel-scale vector per layer
+        assert s.shape == (k.shape[0], k.shape[-1])
 
 
 class TestPromptBucketing:
