@@ -761,9 +761,12 @@ class TransformerLM:
             return logits + mh["bias"].astype(logits.dtype)
         if c.tie_embeddings:
             return L.embedding_attend(params["embed"], x)
-        return jnp.einsum("...d,dv->...v", x,
-                          params["lm_head"]["kernel"].astype(x.dtype),
-                          preferred_element_type=jnp.float32)
+        logits = jnp.einsum("...d,dv->...v", x,
+                            params["lm_head"]["kernel"].astype(x.dtype),
+                            preferred_element_type=jnp.float32)
+        if "bias" in params["lm_head"]:     # GPT-J carries a head bias
+            logits = logits + params["lm_head"]["bias"]
+        return logits
 
     def hidden_states_and_aux(self, params, input_ids, rng=None, train=True,
                               token_type_ids=None):

@@ -513,6 +513,165 @@ def load_hf_llama(state_dict: Dict[str, Any],
     return params
 
 
+def hf_gptj_config(hf_cfg, **overrides) -> TransformerConfig:
+    """transformers.GPTJConfig → TransformerConfig (reference
+    `containers/gptj.py` HFGPTJLayerPolicy): partial interleaved rotary,
+    SINGLE-layernorm parallel residual (ln_1 feeds both attn and mlp —
+    expressed by loading identical ln1/ln2, mathematically exact), no
+    attention biases (loaded as zeros), untied lm_head WITH bias."""
+    hdim = hf_cfg.n_embd // hf_cfg.n_head
+    return TransformerConfig(
+        vocab_size=hf_cfg.vocab_size,
+        max_seq_len=hf_cfg.n_positions,
+        num_layers=hf_cfg.n_layer,
+        num_heads=hf_cfg.n_head,
+        d_model=hf_cfg.n_embd,
+        d_ff=(hf_cfg.n_inner or 4 * hf_cfg.n_embd),
+        pos_embedding="rotary",
+        rotary_pct=hf_cfg.rotary_dim / hdim,
+        rotary_interleaved=True,      # GPT-J rotates every two
+        parallel_residual=True,
+        norm_type="layernorm",
+        activation=_map_act(hf_cfg.activation_function),
+        use_bias=True,
+        tie_embeddings=False,
+        layernorm_eps=hf_cfg.layer_norm_epsilon,
+        **overrides)
+
+
+def load_hf_gptj(state_dict: Dict[str, Any],
+                 config: TransformerConfig) -> Dict:
+    sd = {k.replace("transformer.", ""): v for k, v in state_dict.items()}
+    n, d = config.num_layers, config.d_model
+
+    def t(name, i):
+        return _np(sd[f"h.{i}.{name}.weight"]).T
+
+    qkv_w = np.stack([np.concatenate(
+        [t("attn.q_proj", i), t("attn.k_proj", i), t("attn.v_proj", i)],
+        axis=-1) for i in range(n)])
+    zeros_b = np.zeros((n, 3 * d), np.float32)
+    ln1_s = _stack(sd, "h.{i}.ln_1.weight", n)
+    ln1_b = _stack(sd, "h.{i}.ln_1.bias", n)
+    params = {
+        "embed": {"embedding": _np(sd["wte.weight"])},
+        "blocks": {
+            "ln1": {"scale": ln1_s, "bias": ln1_b},
+            "attn": {
+                "qkv": {"kernel": qkv_w, "bias": zeros_b},
+                "out": {"kernel": np.stack(
+                    [t("attn.out_proj", i) for i in range(n)]),
+                    "bias": np.zeros((n, d), np.float32)},
+            },
+            # single-LN parallel residual: ln2 := ln_1 (same input x)
+            "ln2": {"scale": ln1_s.copy(), "bias": ln1_b.copy()},
+            "mlp": {
+                "fc_in": {"kernel": np.stack(
+                    [t("mlp.fc_in", i) for i in range(n)]),
+                    "bias": _stack(sd, "h.{i}.mlp.fc_in.bias", n)},
+                "fc_out": {"kernel": np.stack(
+                    [t("mlp.fc_out", i) for i in range(n)]),
+                    "bias": _stack(sd, "h.{i}.mlp.fc_out.bias", n)},
+            },
+        },
+        "ln_f": {"scale": _np(sd["ln_f.weight"]),
+                 "bias": _np(sd["ln_f.bias"])},
+        "lm_head": {"kernel": _np(state_dict["lm_head.weight"]).T,
+                    "bias": _np(state_dict["lm_head.bias"])},
+    }
+    return params
+
+
+def hf_distilbert_config(hf_cfg, **overrides) -> TransformerConfig:
+    """transformers.DistilBertConfig → TransformerConfig (reference
+    `containers/distil_bert.py`): BERT-style post-norm encoder without
+    token types; MLM head tied to the word embeddings."""
+    if getattr(hf_cfg, "sinusoidal_pos_embds", False):
+        raise NotImplementedError(
+            "DistilBERT with sinusoidal_pos_embds: only the learned-"
+            "position variant (the published checkpoints) is mapped")
+    return TransformerConfig(
+        vocab_size=hf_cfg.vocab_size,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        num_layers=hf_cfg.n_layers,
+        num_heads=hf_cfg.n_heads,
+        d_model=hf_cfg.dim,
+        d_ff=hf_cfg.hidden_dim,
+        pos_embedding="learned",
+        causal=False,
+        norm_position="post",
+        final_layernorm=False,
+        embed_layernorm=True,
+        mlm_head=True,
+        norm_type="layernorm",
+        activation=_map_act(hf_cfg.activation),
+        use_bias=True,
+        tie_embeddings=True,
+        layernorm_eps=1e-12,
+        **overrides)
+
+
+def load_hf_distilbert(state_dict: Dict[str, Any],
+                       config: TransformerConfig) -> Dict:
+    sd = {k.replace("distilbert.", ""): v for k, v in state_dict.items()}
+    n = config.num_layers
+    pre = "transformer.layer.{i}."
+
+    def t(name, i):
+        return _np(sd[f"transformer.layer.{i}.{name}.weight"]).T
+
+    def b(name, i):
+        return _np(sd[f"transformer.layer.{i}.{name}.bias"])
+
+    qkv_w = np.stack([np.concatenate(
+        [t("attention.q_lin", i), t("attention.k_lin", i),
+         t("attention.v_lin", i)], axis=-1) for i in range(n)])
+    qkv_b = np.stack([np.concatenate(
+        [b("attention.q_lin", i), b("attention.k_lin", i),
+         b("attention.v_lin", i)]) for i in range(n)])
+    params = {
+        "embed": {"embedding": _np(
+            sd["embeddings.word_embeddings.weight"])},
+        "pos_embed": {"embedding": _np(
+            sd["embeddings.position_embeddings.weight"])},
+        "ln_embed": {"scale": _np(sd["embeddings.LayerNorm.weight"]),
+                     "bias": _np(sd["embeddings.LayerNorm.bias"])},
+        "blocks": {
+            "ln1": {"scale": _stack(sd, pre + "sa_layer_norm.weight", n),
+                    "bias": _stack(sd, pre + "sa_layer_norm.bias", n)},
+            "attn": {
+                "qkv": {"kernel": qkv_w, "bias": qkv_b},
+                "out": {"kernel": np.stack(
+                    [t("attention.out_lin", i) for i in range(n)]),
+                    "bias": np.stack(
+                        [b("attention.out_lin", i) for i in range(n)])},
+            },
+            "ln2": {"scale": _stack(sd, pre + "output_layer_norm.weight",
+                                    n),
+                    "bias": _stack(sd, pre + "output_layer_norm.bias",
+                                   n)},
+            "mlp": {
+                "fc_in": {"kernel": np.stack(
+                    [t("ffn.lin1", i) for i in range(n)]),
+                    "bias": np.stack([b("ffn.lin1", i)
+                                      for i in range(n)])},
+                "fc_out": {"kernel": np.stack(
+                    [t("ffn.lin2", i) for i in range(n)]),
+                    "bias": np.stack([b("ffn.lin2", i)
+                                      for i in range(n)])},
+            },
+        },
+        "mlm_head": {
+            "dense": {"kernel": _np(state_dict["vocab_transform.weight"]).T,
+                      "bias": _np(state_dict["vocab_transform.bias"])},
+            "ln": {"scale": _np(state_dict["vocab_layer_norm.weight"]),
+                   "bias": _np(state_dict["vocab_layer_norm.bias"])},
+            "bias": _np(state_dict["vocab_projector.bias"]),
+        },
+    }
+    return params
+
+
 # registry (reference replace_policy.py:17)
 POLICIES = {
     "gpt2": (hf_gpt2_config, load_hf_gpt2),
@@ -521,7 +680,14 @@ POLICIES = {
     "bloom": (hf_bloom_config, load_hf_bloom),
     "bert": (hf_bert_config, load_hf_bert),
     "llama": (hf_llama_config, load_hf_llama),
+    "gptj": (hf_gptj_config, load_hf_gptj),
+    "distilbert": (hf_distilbert_config, load_hf_distilbert),
 }
+# gpt_neo is deliberately ABSENT: its alternating global/local attention
+# (window 256) cannot be expressed by this framework's uniform scanned
+# block without a heterogeneous superblock (the dense+moe superblock
+# pattern generalized to per-sub-block attention masks) — rejected via
+# the registry error rather than shipping wrong long-context math.
 
 
 def convert_hf_model(hf_model, **config_overrides):

@@ -183,6 +183,57 @@ class TestHFPolicies:
         got = np.asarray(model.apply(params, jnp.asarray(ids)))
         np.testing.assert_allclose(got, want, atol=2e-3)
 
+    def test_gptj_logit_parity(self):
+        """GPT-J (r4): partial interleaved rotary, single-LN parallel
+        residual (mapped as ln1==ln2), biased untied lm_head."""
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        hf_cfg = transformers.GPTJConfig(
+            vocab_size=96, n_positions=32, n_embd=48, n_layer=3, n_head=4,
+            rotary_dim=8, activation_function="gelu_new", resid_pdrop=0.0,
+            embd_pdrop=0.0, attn_pdrop=0.0)
+        hf = transformers.GPTJForCausalLM(hf_cfg).eval()
+        from deepspeed_tpu.module_inject import convert_hf_model
+        cfg, params = convert_hf_model(hf, dtype=jnp.float32, loss_chunk=0)
+        assert cfg.parallel_residual and cfg.rotary_interleaved
+        model = TransformerLM(cfg)
+        ids = np.random.RandomState(0).randint(0, 96, (2, 16))
+        with torch.no_grad():
+            want = hf(torch.tensor(ids)).logits.numpy()
+        got = np.asarray(model.apply(params, jnp.asarray(ids)))
+        np.testing.assert_allclose(got, want, atol=2e-3)
+
+    def test_distilbert_logit_parity(self):
+        """DistilBERT (r4): post-norm encoder, embed LN, no token types,
+        tied MLM head."""
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        hf_cfg = transformers.DistilBertConfig(
+            vocab_size=96, max_position_embeddings=32, dim=48,
+            n_layers=3, n_heads=4, hidden_dim=192, dropout=0.0,
+            attention_dropout=0.0)
+        hf = transformers.DistilBertForMaskedLM(hf_cfg).eval()
+        from deepspeed_tpu.module_inject import convert_hf_model
+        cfg, params = convert_hf_model(hf, dtype=jnp.float32, loss_chunk=0)
+        assert not cfg.causal and cfg.mlm_head
+        model = TransformerLM(cfg)
+        ids = np.random.RandomState(0).randint(0, 96, (2, 16))
+        with torch.no_grad():
+            want = hf(torch.tensor(ids)).logits.numpy()
+        got = np.asarray(model.apply(params, jnp.asarray(ids)))
+        np.testing.assert_allclose(got, want, atol=2e-3)
+
+    def test_gpt_neo_rejected_with_reason(self):
+        """GPT-Neo's alternating global/local attention cannot map onto
+        the uniform scanned block — the registry rejects it loudly."""
+        from deepspeed_tpu.module_inject import convert_hf_model
+
+        class FakeNeo:
+            class config:
+                model_type = "gpt_neo"
+        with pytest.raises(ValueError, match="gpt_neo"):
+            convert_hf_model(FakeNeo())
+
     def test_opt_logit_parity(self):
         torch = pytest.importorskip("torch")
         transformers = pytest.importorskip("transformers")
