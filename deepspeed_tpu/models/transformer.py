@@ -78,7 +78,7 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16          # activation dtype
     param_dtype: Any = jnp.float32
     remat: str = "none"                # none | full | dots_saveable | nothing_saveable
-    attn_impl: str = "xla"             # xla | flash | ring | blocksparse
+    attn_impl: str = "xla"     # xla | flash | ring | ulysses | blocksparse
     # attn_impl="blocksparse": an ops.sparse_attention.SparsityConfig
     # (Fixed/LocalSlidingWindow/BigBird/BSLongformer/Variable) — the layout
     # drives the Pallas block-sparse flash kernel
@@ -468,17 +468,23 @@ class TransformerLM:
 
         new_cache = None
         offset = 0
-        if cache_kv is None and c.attn_impl in ("ring", "blocksparse",
-                                                "flash"):
+        if cache_kv is None and c.attn_impl in ("ring", "ulysses",
+                                                "blocksparse", "flash"):
             k, v = expand_kv(k), expand_kv(v)
-        if cache_kv is None and c.attn_impl == "ring":
-            from ..ops.transformer.ring_attention import ring_attention
+        if cache_kv is None and c.attn_impl in ("ring", "ulysses"):
             from ..parallel.topology import SEQUENCE_AXIS
             if self.mesh is None or self.mesh.shape.get(SEQUENCE_AXIS, 1) < 2:
                 raise ValueError(
-                    "attn_impl='ring' needs a bound mesh with sequence>=2 "
-                    "(engine binds it; or call model.bind_mesh(mesh))")
-            o = ring_attention(q, k, v, self.mesh)
+                    f"attn_impl={c.attn_impl!r} needs a bound mesh with "
+                    f"sequence>=2 (engine binds it; or call "
+                    f"model.bind_mesh(mesh))")
+            if c.attn_impl == "ring":
+                from ..ops.transformer.ring_attention import ring_attention
+                o = ring_attention(q, k, v, self.mesh)
+            else:
+                from ..ops.transformer.ulysses_attention import (
+                    ulysses_attention)
+                o = ulysses_attention(q, k, v, self.mesh, causal=c.causal)
             o = o.reshape(b, t, nh * hd)
             return L.dense_apply(p["out"], o), None
         if cache_kv is None and c.attn_impl == "blocksparse":

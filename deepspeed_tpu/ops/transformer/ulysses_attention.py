@@ -1,0 +1,73 @@
+"""Ulysses-style sequence parallelism — all_to_all head scatter.
+
+The second context-parallel form SURVEY §5.7 calls for (the reference
+lacks both; `ring_attention.py` is the first): instead of rotating K/V
+blocks around a ring, ONE all_to_all re-shards [B, T/s, H, D] sequence
+shards into [B, T, H/s, D] head shards, every device runs ordinary
+full-sequence attention over its head subset, and a second all_to_all
+restores sequence sharding. DeepSpeed later shipped exactly this as
+"DeepSpeed-Ulysses"; here it is two `lax.all_to_all`s inside a
+partial-manual `shard_map` over the ``sequence`` axis.
+
+Trade-off vs ring (why both exist): Ulysses moves 2 x the activation
+volume in two dense all_to_alls (great on ICI's all-to-all bandwidth,
+one software step) but needs heads % s == 0; ring keeps heads intact
+and pipelines s ppermute steps (wins when heads are few or the
+sequence enormous). Same call signature, config-selectable
+(``attn_impl="ulysses"``).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ...parallel.topology import SEQUENCE_AXIS
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      mesh: Mesh, axis: str = SEQUENCE_AXIS,
+                      sm_scale: Optional[float] = None,
+                      causal: bool = True) -> jnp.ndarray:
+    """q, k, v: [B, T, H, D] global view, T sharded over ``axis``.
+    Returns [B, T, H, D] sequence-sharded like the inputs."""
+    s = mesh.shape.get(axis, 1)
+    if s <= 1:
+        raise ValueError(f"ulysses_attention needs mesh axis {axis!r} > 1")
+    if q.shape[1] % s:
+        raise ValueError(f"seq len {q.shape[1]} not divisible by "
+                         f"{axis}={s}")
+    if q.shape[2] % s:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[2]}) divisible by the "
+            f"sequence axis ({s}) — use attn_impl='ring' otherwise")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def local_fn(ql, kl, vl):
+        # seq-shard -> head-shard: split heads (axis 2), gather seq (1)
+        def scatter_heads(x):
+            return jax.lax.all_to_all(x, axis, split_axis=2,
+                                      concat_axis=1, tiled=True)
+        qg, kg, vg = scatter_heads(ql), scatter_heads(kl), \
+            scatter_heads(vl)
+        # ordinary full-sequence attention over H/s heads
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qg, kg,
+                            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            t = qg.shape[1]
+            mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+            logits = jnp.where(mask[None, None], logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1).astype(qg.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, vg)
+        # head-shard -> seq-shard: split seq (1), gather heads (2)
+        return jax.lax.all_to_all(o, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, axis_names={axis}, check_vma=False)
+    return fn(q, k, v)

@@ -60,6 +60,55 @@ class TestRingAttentionOp:
             ring_attention(q, q, q, mesh)
 
 
+class TestUlyssesAttentionOp:
+    """Ulysses all_to_all head-scatter SP (the second context-parallel
+    form of SURVEY §5.7; DeepSpeed later shipped it as
+    DeepSpeed-Ulysses)."""
+
+    @pytest.mark.parametrize("seq_par,t", [(4, 64), (2, 16)])
+    def test_fwd_matches_full_attention(self, seq_par, t):
+        from deepspeed_tpu.ops.transformer.ulysses_attention import (
+            ulysses_attention)
+        mesh = build_mesh(MeshConfig(data=8 // seq_par, sequence=seq_par))
+        b, h, d = 2, 4, 16
+        q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (b, t, h, d))
+                   for i in range(3))
+        with mesh:
+            out = jax.jit(lambda q, k, v: ulysses_attention(
+                q, k, v, mesh))(q, k, v)
+        ref = L.causal_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_bwd_matches_full_attention(self):
+        from deepspeed_tpu.ops.transformer.ulysses_attention import (
+            ulysses_attention)
+        mesh = seq_mesh()
+        b, t, h, d = 2, 32, 4, 16
+        q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (b, t, h, d))
+                   for i in range(3))
+
+        def f_uly(q, k, v):
+            return jnp.sum(ulysses_attention(q, k, v, mesh) ** 2)
+
+        def f_ref(q, k, v):
+            return jnp.sum(L.causal_attention(q, k, v) ** 2)
+        with mesh:
+            gu = jax.jit(jax.grad(f_uly, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(gu, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=5e-5)
+
+    def test_rejects_indivisible_heads(self):
+        from deepspeed_tpu.ops.transformer.ulysses_attention import (
+            ulysses_attention)
+        mesh = build_mesh(MeshConfig(data=1, sequence=8))
+        q = jnp.zeros((1, 64, 4, 8))    # 4 heads, 8-way sequence
+        with pytest.raises(ValueError, match="heads"):
+            ulysses_attention(q, q, q, mesh)
+
+
 class TestSequenceParallelTraining:
     def _model(self, attn="xla", seq=64):
         cfg = gpt2_config("125m", num_layers=4, d_model=32, num_heads=4,
@@ -89,6 +138,14 @@ class TestSequenceParallelTraining:
         ref = self._losses(self._model("xla"), {"data": 8})
         ring = self._losses(self._model("ring"), {"data": 2, "sequence": 4})
         np.testing.assert_allclose(ref, ring, rtol=2e-4)
+
+    def test_ulysses_training_matches_dense(self):
+        """SP(4) x DP(2) Ulysses training == single-program XLA attention
+        (same seeds) — the same numerics bar as ring."""
+        ref = self._losses(self._model("xla"), {"data": 8})
+        uly = self._losses(self._model("ulysses"),
+                           {"data": 2, "sequence": 4})
+        np.testing.assert_allclose(ref, uly, rtol=2e-4)
 
     def test_ring_with_zero2(self):
         model = self._model("ring")
