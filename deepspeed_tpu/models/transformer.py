@@ -478,13 +478,15 @@ class TransformerLM:
                     f"attn_impl={c.attn_impl!r} needs a bound mesh with "
                     f"sequence>=2 (engine binds it; or call "
                     f"model.bind_mesh(mesh))")
+            use_alibi = c.pos_embedding == "alibi"
             if c.attn_impl == "ring":
                 from ..ops.transformer.ring_attention import ring_attention
-                o = ring_attention(q, k, v, self.mesh)
+                o = ring_attention(q, k, v, self.mesh, alibi=use_alibi)
             else:
                 from ..ops.transformer.ulysses_attention import (
                     ulysses_attention)
-                o = ulysses_attention(q, k, v, self.mesh, causal=c.causal)
+                o = ulysses_attention(q, k, v, self.mesh, causal=c.causal,
+                                      alibi=use_alibi)
             o = o.reshape(b, t, nh * hd)
             return L.dense_apply(p["out"], o), None
         if cache_kv is None and c.attn_impl == "blocksparse":

@@ -33,14 +33,19 @@ from ...parallel.topology import SEQUENCE_AXIS
 MASK_VALUE = -1e30
 
 
-def _ring_body(q, kk, vv, m, l, acc, *, q_off, k_off, scale):
+def _ring_body(q, kk, vv, m, l, acc, *, q_off, k_off, scale,
+               slopes=None):
     """One block-attention accumulation step (online softmax update).
-    q [B,Tq,H,D]; kk/vv [B,Tk,H,D]; m,l [B,H,Tq]; acc [B,Tq,H,D]."""
+    q [B,Tq,H,D]; kk/vv [B,Tk,H,D]; m,l [B,H,Tq]; acc [B,Tq,H,D].
+    ``slopes`` [H] — ALiBi distance penalty on GLOBAL positions."""
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk,
                         preferred_element_type=jnp.float32) * scale
     tq, tk = q.shape[1], kk.shape[1]
     q_pos = q_off + jnp.arange(tq)
     k_pos = k_off + jnp.arange(tk)
+    if slopes is not None:
+        rel = -jnp.abs(k_pos[None, :] - q_pos[:, None]).astype(jnp.float32)
+        logits = logits + slopes[:, None, None] * rel[None]
     mask = q_pos[:, None] >= k_pos[None, :]
     logits = jnp.where(mask[None, None], logits, MASK_VALUE)
     m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
@@ -57,11 +62,13 @@ def _ring_body(q, kk, vv, m, l, acc, *, q_off, k_off, scale):
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    mesh: Mesh, axis: str = SEQUENCE_AXIS,
-                   sm_scale: Optional[float] = None) -> jnp.ndarray:
+                   sm_scale: Optional[float] = None,
+                   alibi: bool = False) -> jnp.ndarray:
     """Causal self-attention with K/V ring rotation.
 
     q, k, v: [B, T, H, D] (global view; T is sharded over ``axis`` inside).
-    Returns [B, T, H, D] in q.dtype.
+    Returns [B, T, H, D] in q.dtype. ``alibi`` adds the ALiBi distance
+    penalty (global positions — the ring body already carries them).
     """
     s = mesh.shape.get(axis, 1)
     if s <= 1:
@@ -70,6 +77,10 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         raise ValueError(f"seq len {q.shape[1]} not divisible by {axis}={s}")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    slopes = None
+    if alibi:
+        from ...models import layers as L
+        slopes = L.alibi_slopes(q.shape[2])
 
     def local_fn(ql, kl, vl):
         # local shards [B, T/s, H, D]
@@ -77,7 +88,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         b, tq, h, d = ql.shape
         q_off = sid * tq
 
-        body = jax.checkpoint(functools.partial(_ring_body, scale=sm_scale))
+        body = jax.checkpoint(functools.partial(_ring_body, scale=sm_scale,
+                                                slopes=slopes))
 
         def step(carry, t):
             kk, vv, m, l, acc = carry

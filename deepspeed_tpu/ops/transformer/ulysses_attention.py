@@ -31,9 +31,12 @@ from ...parallel.topology import SEQUENCE_AXIS
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       mesh: Mesh, axis: str = SEQUENCE_AXIS,
                       sm_scale: Optional[float] = None,
-                      causal: bool = True) -> jnp.ndarray:
+                      causal: bool = True,
+                      alibi: bool = False) -> jnp.ndarray:
     """q, k, v: [B, T, H, D] global view, T sharded over ``axis``.
-    Returns [B, T, H, D] sequence-sharded like the inputs."""
+    Returns [B, T, H, D] sequence-sharded like the inputs. ``alibi``
+    applies the ALiBi distance penalty with each device's slice of the
+    head slopes (heads are the sharded dim after the scatter)."""
     s = mesh.shape.get(axis, 1)
     if s <= 1:
         raise ValueError(f"ulysses_attention needs mesh axis {axis!r} > 1")
@@ -46,23 +49,30 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             f"sequence axis ({s}) — use attn_impl='ring' otherwise")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    n_heads = q.shape[2]
 
     def local_fn(ql, kl, vl):
+        from ...models import layers as L
         # seq-shard -> head-shard: split heads (axis 2), gather seq (1)
         def scatter_heads(x):
             return jax.lax.all_to_all(x, axis, split_axis=2,
                                       concat_axis=1, tiled=True)
         qg, kg, vg = scatter_heads(ql), scatter_heads(kl), \
             scatter_heads(vl)
-        # ordinary full-sequence attention over H/s heads
-        logits = jnp.einsum("bqhd,bkhd->bhqk", qg, kg,
-                            preferred_element_type=jnp.float32) * sm_scale
-        if causal:
+        bias = None
+        if alibi:
+            # this device holds heads [sid*hs, (sid+1)*hs): slice the
+            # slope vector to match, positions are GLOBAL post-gather
+            hs = n_heads // s
+            sid = jax.lax.axis_index(axis)
             t = qg.shape[1]
-            mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
-            logits = jnp.where(mask[None, None], logits, -1e30)
-        p = jax.nn.softmax(logits, axis=-1).astype(qg.dtype)
-        o = jnp.einsum("bhqk,bkhd->bqhd", p, vg)
+            full = L.alibi_bias(n_heads, t, jnp.arange(t))   # [H,Tq,Tk]
+            bias = jax.lax.dynamic_slice_in_dim(full, sid * hs, hs,
+                                                axis=0)[None]
+        # ordinary full-sequence attention over H/s heads (the shared
+        # core — single source of the mask/softmax/dtype policy)
+        o = L.causal_attention(qg, kg, vg, scale=sm_scale, causal=causal,
+                               bias=bias)
         # head-shard -> seq-shard: split seq (1), gather heads (2)
         return jax.lax.all_to_all(o, axis, split_axis=1, concat_axis=2,
                                   tiled=True)

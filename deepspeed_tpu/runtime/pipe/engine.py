@@ -195,11 +195,12 @@ class PipelineEngine(DeepSpeedEngine):
                 "1f1b schedule does not carry the MoE aux loss yet; use "
                 "pipeline.schedule=gpipe for MoE models")
         mcfg = adapter.config
-        if getattr(mcfg, "attn_impl", None) == "ring":
+        if getattr(mcfg, "attn_impl", None) in ("ring", "ulysses"):
             raise NotImplementedError(
-                "ring attention (sequence parallel) inside the compiled "
-                "pipeline loop would nest manual collectives over "
-                "pipe+sequence — not supported yet; use ring without PP")
+                "ring/ulysses attention (sequence parallel) inside the "
+                "compiled pipeline loop would nest manual collectives over "
+                "pipe+sequence — not supported yet; use sequence "
+                "parallelism without PP")
         if getattr(mcfg, "moe_enabled", False) and \
                 mcfg.moe_noisy_gate_policy == "RSample":
             raise NotImplementedError(

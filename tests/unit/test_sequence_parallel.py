@@ -108,6 +108,31 @@ class TestUlyssesAttentionOp:
         with pytest.raises(ValueError, match="heads"):
             ulysses_attention(q, q, q, mesh)
 
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_alibi_matches_full_attention(self, impl):
+        """ALiBi (BLOOM's positional signal) must survive both SP forms:
+        ring adds the distance penalty at global block positions, ulysses
+        slices the head slopes per device after the scatter."""
+        from deepspeed_tpu.ops.transformer.ring_attention import (
+            ring_attention)
+        from deepspeed_tpu.ops.transformer.ulysses_attention import (
+            ulysses_attention)
+        mesh = seq_mesh()
+        b, t, h, d = 2, 32, 4, 16
+        q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (b, t, h, d))
+                   for i in range(3))
+        bias = L.alibi_bias(h, t, jnp.arange(t))[None]
+        ref = L.causal_attention(q, k, v, bias=bias)
+        with mesh:
+            if impl == "ring":
+                out = jax.jit(lambda q, k, v: ring_attention(
+                    q, k, v, mesh, alibi=True))(q, k, v)
+            else:
+                out = jax.jit(lambda q, k, v: ulysses_attention(
+                    q, k, v, mesh, alibi=True))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
 
 class TestSequenceParallelTraining:
     def _model(self, attn="xla", seq=64):
