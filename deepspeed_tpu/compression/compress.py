@@ -180,9 +180,12 @@ def parse_compression_config(d: Dict[str, Any]) -> CompressionConfig:
                                         d.get("channel_pruning", {})
                                         ).get("enabled"):
         raise NotImplementedError(
-            "channel_pruning targets conv channels — this framework's "
-            "model zoo is transformer LMs; use row_pruning for feature "
-            "pruning or sparse_pruning for unstructured")
+            "channel_pruning targets conv channels during TRAINING; the "
+            "compression pipeline wraps the LM training loss, and the "
+            "conv family here (models/diffusion.py UNet/VAE) is a "
+            "serving-only stack with no training seam to prune through. "
+            "Use row_pruning for transformer feature pruning or "
+            "sparse_pruning for unstructured")
 
     wq_block = d.get("weight_quantization", {})
     if "shared_parameters" in wq_block:
