@@ -622,6 +622,55 @@ class DDIMScheduler:
         x0 = (sample - jnp.sqrt(1 - a_t) * eps) / jnp.sqrt(a_t)
         return jnp.sqrt(a_prev) * x0 + jnp.sqrt(1 - a_prev) * eps
 
+    # pipeline seam (shared with EulerDiscreteScheduler): DDIM's latent
+    # state is already in the UNet's variance-preserving space
+    def scale_model_input(self, sample, t):
+        return sample
+
+    def init_noise_sigma(self, t0) -> float:
+        return 1.0
+
+
+class EulerDiscreteScheduler:
+    """Deterministic Euler sampling (SD 2.x's shipped scheduler family):
+    the latent state lives in sigma space (x = x0 + sigma * eps,
+    sigma = sqrt((1-acp)/acp)), the UNet input is rescaled by
+    1/sqrt(sigma^2+1), and each step is a first-order ODE update
+    x <- x + (sigma_prev - sigma) * eps."""
+
+    def __init__(self, config: DDIMConfig = DDIMConfig()):
+        self.config = config
+        c = config
+        if c.beta_schedule == "scaled_linear":
+            betas = np.linspace(c.beta_start ** 0.5, c.beta_end ** 0.5,
+                                c.num_train_timesteps) ** 2
+        else:
+            betas = np.linspace(c.beta_start, c.beta_end,
+                                c.num_train_timesteps)
+        ac = np.cumprod(1.0 - betas)
+        self.alphas_cumprod = jnp.asarray(ac, jnp.float32)
+        self.sigmas = jnp.asarray(np.sqrt((1.0 - ac) / ac), jnp.float32)
+
+    def timesteps(self, num_steps: int) -> np.ndarray:
+        c = self.config
+        step = c.num_train_timesteps // num_steps
+        ts = (np.arange(num_steps) * step).round()[::-1].astype(np.int32)
+        return np.minimum(ts + c.steps_offset, c.num_train_timesteps - 1)
+
+    def init_noise_sigma(self, t0) -> float:
+        return float(self.sigmas[int(t0)])
+
+    def scale_model_input(self, sample, t):
+        s = self.sigmas[t]
+        return sample / jnp.sqrt(s * s + 1.0)
+
+    def step(self, eps, t, t_prev, sample):
+        s = self.sigmas[t]
+        s_prev = jnp.where(t_prev >= 0,
+                           self.sigmas[jnp.maximum(t_prev, 0)], 0.0)
+        # epsilon prediction: dx/dsigma = eps
+        return sample + (s_prev - s) * eps
+
 
 class StableDiffusionPipeline:
     """Text -> image: CLIP encode, DDIM loop over the jitted UNet with
@@ -641,7 +690,8 @@ class StableDiffusionPipeline:
         self._encode_text = jax.jit(self.text.apply)
 
     def _raw_unet_step(self, up, latents, t, t_prev, ctx, guidance):
-        both = jnp.concatenate([latents, latents], axis=0)
+        model_in = self.scheduler.scale_model_input(latents, t)
+        both = jnp.concatenate([model_in, model_in], axis=0)
         tt = jnp.full((both.shape[0],), t, jnp.int32)
         eps = self.unet.apply(up, both, tt, ctx)
         e_uncond, e_text = jnp.split(eps, 2, axis=0)
@@ -660,11 +710,12 @@ class StableDiffusionPipeline:
         ctx = jnp.concatenate([
             self._encode_text(params["text_encoder"], uncond_ids),
             self._encode_text(params["text_encoder"], prompt_ids)], axis=0)
+        ts = self.scheduler.timesteps(num_steps)
         if latents is None:
             rng = rng if rng is not None else jax.random.PRNGKey(0)
             latents = jax.random.normal(
                 rng, (b, hh, ww, uc.in_channels), jnp.float32)
-        ts = self.scheduler.timesteps(num_steps)
+            latents = latents * self.scheduler.init_noise_sigma(ts[0])
         for i, t in enumerate(ts):
             t_prev = ts[i + 1] if i + 1 < len(ts) else -1
             latents = self._unet_step(params["unet"], latents,

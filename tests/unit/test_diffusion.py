@@ -445,6 +445,49 @@ class TestSchedulerPipeline:
         assert ts[0] == 981 and ts[-1] == 1
         assert float(s.final_alpha_cumprod) == float(s.alphas_cumprod[0])
 
+    def test_euler_recovers_x0_and_scales_input(self):
+        """Euler in sigma space: x = x0 + sigma*eps steps to exactly x0
+        with the true noise; model input rescales to the VP space."""
+        from deepspeed_tpu.models.diffusion import EulerDiscreteScheduler
+        s = EulerDiscreteScheduler()
+        rs = np.random.RandomState(0)
+        x0 = jnp.asarray(rs.randn(1, 4, 4, 4), jnp.float32)
+        eps = jnp.asarray(rs.randn(1, 4, 4, 4), jnp.float32)
+        t = 600
+        x = x0 + s.sigmas[t] * eps
+        rec = s.step(eps, t, -1, x)
+        np.testing.assert_allclose(np.asarray(rec), np.asarray(x0),
+                                   atol=1e-4)
+        # scaled input equals the VP-space latent sqrt(acp)*x0+sqrt(1-a)e
+        vp = (jnp.sqrt(s.alphas_cumprod[t]) * x0
+              + jnp.sqrt(1 - s.alphas_cumprod[t]) * eps)
+        np.testing.assert_allclose(np.asarray(s.scale_model_input(x, t)),
+                                   np.asarray(vp), atol=1e-4)
+
+    def test_pipeline_with_euler_scheduler(self):
+        from deepspeed_tpu.models.diffusion import EulerDiscreteScheduler
+        cfg = tiny_unet_cfg()
+        vcfg = VAEConfig(block_out_channels=(16, 32), layers_per_block=1,
+                         norm_num_groups=8)
+        ccfg = CLIPTextConfig(vocab_size=64, hidden_size=24,
+                              intermediate_size=48, num_hidden_layers=2,
+                              num_attention_heads=2,
+                              max_position_embeddings=8)
+        unet, vae, clip = (UNet2DCondition(cfg), AutoencoderKL(vcfg),
+                           CLIPTextEncoder(ccfg))
+        pipe = StableDiffusionPipeline(
+            unet, vae, clip, scheduler=EulerDiscreteScheduler())
+        params = {"unet": load_unet(cfg, synth_unet_sd(cfg)),
+                  "vae": vae.init(jax.random.PRNGKey(1)),
+                  "text_encoder": clip.init(jax.random.PRNGKey(2))}
+        ids = np.array([[1, 2, 3, 4, 5, 6, 7, 8]], np.int32)
+        a = pipe(params, ids, np.zeros_like(ids), num_steps=3, height=32,
+                 width=32, rng=jax.random.PRNGKey(7))
+        b = pipe(params, ids, np.zeros_like(ids), num_steps=3, height=32,
+                 width=32, rng=jax.random.PRNGKey(7))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert np.isfinite(np.asarray(a)).all()
+
     def test_pipeline_deterministic_and_guided(self):
         cfg = tiny_unet_cfg()
         vcfg = VAEConfig(block_out_channels=(16, 32), layers_per_block=1,
