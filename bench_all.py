@@ -124,15 +124,20 @@ def blocksparse_bench(seq: int = 8192, heads: int = 8, d: int = 128,
         num_heads=heads, block=512, num_sliding_window_blocks=3)
 
     def run(f, q, k, v):
-        # grad-output chained into the next call's input: repeated
-        # IDENTICAL dispatches get elided by the tunnel
+        # Every dispatch must see a GENUINELY distinct input: additive
+        # eps-perturbations underflow in bf16 (input bit-identical →
+        # the tunnel elides the dispatch; r3's chain had this flaw), so
+        # roll the query each iteration. Sync by fetching a reduction —
+        # block_until_ready returns early on this backend.
         loss = jax.jit(jax.grad(lambda q: jnp.sum(f(q, k, v) ** 2)))
         g = loss(q)
-        g.block_until_ready()
+        float(jnp.sum(jnp.abs(g.astype(jnp.float32))))
+        qq = q
         t0 = time.perf_counter()
         for _ in range(iters):
-            g = loss(q + 1e-6 * g)
-        g.block_until_ready()
+            qq = jnp.roll(qq, 1, axis=1)
+            g = loss(qq)
+        float(jnp.sum(jnp.abs(g.astype(jnp.float32))))
         return (time.perf_counter() - t0) / iters * 1000
 
     res = {}
