@@ -855,13 +855,19 @@ class TransformerLM:
         aux_coef = (self.config.moe_aux_loss_coef
                     if self.config.moe_enabled else 0.0)
 
+        x, laux = self.hidden_states_and_aux(params, logits_in, rng=moe_rng)
+        return self.nll_from_hidden(params, x, labels, mask) \
+            + aux_coef * laux
+
+    def nll_from_hidden(self, params, x, labels, mask=None) -> jnp.ndarray:
+        """Mean masked NLL from final hidden states ([B,T,D]) — the loss
+        HEAD alone, exposed so it can be timed/attributed separately from
+        the trunk (bench.py phase breakdown)."""
         chunk = self.config.loss_chunk
         t = labels.shape[1]
         if chunk and t > chunk and t % chunk == 0:
             # Chunked CE: never materialize [B,T,V]; per chunk the projection
             # + logsumexp recompute in backward (jax.checkpoint).
-            x, laux = self.hidden_states_and_aux(params, logits_in,
-                                                 rng=moe_rng)  # [B,T,D]
             n_chunks = t // chunk
 
             def to_chunks(a):
@@ -888,9 +894,8 @@ class TransformerLM:
             (tot, cnt), _ = jax.lax.scan(
                 body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
                 (to_chunks(x), to_chunks(labels), mc_all))
-            return tot / jnp.maximum(cnt, 1.0) + aux_coef * laux
+            return tot / jnp.maximum(cnt, 1.0)
 
-        x, laux = self.hidden_states_and_aux(params, logits_in, rng=moe_rng)
         logits = self._project(params, x)
         # logsumexp form avoids materializing the full [B,T,V] log-prob array
         # (matters at vocab 50k: that array is the single biggest HBM tensor).
@@ -898,10 +903,9 @@ class TransformerLM:
         tgt = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
         nll = lse - tgt
         if mask is None:
-            return jnp.mean(nll) + aux_coef * laux
+            return jnp.mean(nll)
         mask = mask.astype(nll.dtype)
-        return (jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-                + aux_coef * laux)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
     # -- partitioning ------------------------------------------------------
     # TP rules keyed on the TRAILING (module, weight) path pair — depth-
