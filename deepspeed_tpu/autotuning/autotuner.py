@@ -255,8 +255,12 @@ class Autotuner:
         rm = ResourceManager(slots=slots, timeout_s=timeout_s, env=env)
         results = rm.run(specs, workdir)
         self.results = []
-        for spec, res in zip(specs, results):
-            self.results.append({**spec["meta"], "status": res["status"],
+        for idx, (spec, res) in enumerate(zip(specs, results)):
+            # spec_index pins the result row to its exact spec: meta-dict
+            # matching could return a DIFFERENT config that shares the
+            # same coarse meta (advisor r4, low)
+            self.results.append({**spec["meta"], "spec_index": idx,
+                                 "status": res["status"],
                                  "samples_per_sec": res.get(
                                      "samples_per_sec"),
                                  "detail": res.get("detail", "")})
@@ -272,18 +276,16 @@ class Autotuner:
                 "every scheduled autotuning experiment failed — see "
                 f"{workdir}/autotune_report.json")
         best_meta = ranked[0]
-        # rebuild the winning engine config from the meta row
-        for spec in specs:
-            if spec["meta"] == {k: best_meta[k] for k in spec["meta"]}:
-                best = copy.deepcopy(spec["cfg"])
-                kw = {k: v for k, v in best_meta.items()
-                      if k not in ("mb", "zero_stage", "offload", "status",
-                                   "samples_per_sec", "detail")}
-                if kw:
-                    best["_model_overrides"] = kw
-                logger.info(f"scheduled autotune best: {best_meta}")
-                return best
-        raise RuntimeError("internal: winning spec not found")
+        # the winning config is the MEASURED spec, recovered by index
+        spec = specs[best_meta["spec_index"]]
+        best = copy.deepcopy(spec["cfg"])
+        kw = {k: v for k, v in best_meta.items()
+              if k not in ("mb", "zero_stage", "offload", "status",
+                           "samples_per_sec", "detail", "spec_index")}
+        if kw:
+            best["_model_overrides"] = kw
+        logger.info(f"scheduled autotune best: {best_meta}")
+        return best
 
     @staticmethod
     def apply_best(model, best_config: Dict[str, Any]):

@@ -42,15 +42,24 @@ class ResourceManager:
         self.env = dict(env or {})
         self.poll_s = poll_s
 
-    def _launch(self, spec_path: str) -> subprocess.Popen:
+    def _launch(self, spec_path: str,
+                log_path: str) -> subprocess.Popen:
+        """Child output goes to a per-job LOG FILE, not a pipe: a verbose
+        experiment would fill the ~64KiB pipe buffer, block mid-run, and
+        get misclassified as a timeout (advisor r4, low)."""
         env = dict(os.environ)
         env.update(self.env)
-        return subprocess.Popen(
-            [sys.executable, "-m", "deepspeed_tpu.autotuning.exp_runner",
-             spec_path],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
-            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__)))))
+        logf = open(log_path, "wb")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m",
+                 "deepspeed_tpu.autotuning.exp_runner", spec_path],
+                stdout=logf, stderr=subprocess.STDOUT, env=env,
+                cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))))
+        finally:
+            logf.close()      # the child holds its own fd from here
+        return proc
 
     def run(self, specs: List[Dict[str, Any]],
             workdir: str) -> List[Dict[str, Any]]:
@@ -67,17 +76,28 @@ class ResourceManager:
             sp = os.path.join(workdir, f"spec_{i}.json")
             with open(sp, "w") as f:
                 json.dump(spec, f)
-            pending.append((i, sp, spec["result_path"]))
+            lp = os.path.join(workdir, f"job_{i}.log")
+            pending.append((i, sp, spec["result_path"], lp))
         running: Dict[int, Any] = {}
 
-        def harvest(i, proc, result_path, timed_out=False):
+        def tail(log_path: str, n: int = 300) -> str:
+            try:
+                with open(log_path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    f.seek(max(0, f.tell() - n))
+                    return f.read().decode(errors="replace")
+            except OSError:
+                return ""
+
+        def harvest(i, proc, result_path, log_path, timed_out=False):
             if timed_out:
                 proc.kill()
                 proc.wait()
                 results[i] = {"status": "timeout", "samples_per_sec": None,
-                              "detail": f"killed after {self.timeout_s}s"}
+                              "detail": (f"killed after {self.timeout_s}s; "
+                                         f"{tail(log_path)}")}
                 return
-            out, err = proc.communicate()
+            proc.wait()
             if os.path.exists(result_path):
                 with open(result_path) as f:
                     results[i] = json.load(f)
@@ -85,23 +105,23 @@ class ResourceManager:
                 results[i] = {
                     "status": "crash", "samples_per_sec": None,
                     "detail": (f"exit={proc.returncode}; "
-                               f"{err.decode(errors='replace')[-300:]}")}
+                               f"{tail(log_path)}")}
 
         while pending or running:
             while pending and len(running) < self.slots:
-                i, sp, rp = pending.popleft()
-                proc = self._launch(sp)
-                running[i] = (proc, rp, time.monotonic())
+                i, sp, rp, lp = pending.popleft()
+                proc = self._launch(sp, lp)
+                running[i] = (proc, rp, lp, time.monotonic())
                 logger.info(f"autotune scheduler: job {i} launched "
                             f"(pid {proc.pid}, "
                             f"{len(running)}/{self.slots} slots)")
             done = []
-            for i, (proc, rp, t0) in running.items():
+            for i, (proc, rp, lp, t0) in running.items():
                 if proc.poll() is not None:
-                    harvest(i, proc, rp)
+                    harvest(i, proc, rp, lp)
                     done.append(i)
                 elif time.monotonic() - t0 > self.timeout_s:
-                    harvest(i, proc, rp, timed_out=True)
+                    harvest(i, proc, rp, lp, timed_out=True)
                     done.append(i)
             for i in done:
                 running.pop(i)

@@ -8,11 +8,12 @@ one per node — agree through a store on each *generation*'s membership,
 world size, and rank assignment; any agent can trigger a re-rendezvous
 (local worker death) and dead NODES are excluded by heartbeat staleness.
 
-TPU redesign: the store is a shared directory (TPU pods mount shared
-filesystems; the same protocol runs on GCS-fuse) with atomic
-rename-based writes instead of an etcd/c10d TCP service — no extra
-daemon, and the decision logic (world size from the v0.1/v0.2 batch
-solver, contiguous rank blocks by node id) is explicit in
+TPU redesign: the store is pluggable (`store.py`) — a shared directory
+by default (TPU pods mount shared filesystems; the same protocol runs
+on GCS-fuse), or a ``tcp://host:port`` key-value store when no shared
+filesystem exists (the reference rides c10d's TCPStore the same way).
+The decision logic (world size from the v0.1/v0.2 batch solver,
+contiguous rank blocks by node id) is explicit in
 ``FileRendezvous.decide`` rather than hidden in a store transaction.
 
 Generation protocol:
@@ -39,52 +40,38 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..utils.logging import logger
 from .elasticity import ElasticityError, compute_elastic_config
-
-
-def _atomic_write(path: str, data: Dict) -> None:
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(data, f)
-    try:
-        os.rename(tmp, path)          # first writer wins; losers overwrite
-    except OSError:
-        os.unlink(tmp)
+from .store import make_store
 
 
 class FileRendezvous:
-    """One generation directory per rendezvous round in a shared path."""
+    """One generation namespace per rendezvous round in a pluggable
+    store — a shared directory (default) or ``tcp://host:port[?master=1]``
+    (`store.py`). The name is historical; the protocol is store-agnostic."""
 
     def __init__(self, store_path: str, node_id: str, slots: int,
                  settle_s: float = 0.6, decide_grace_s: float = 2.0,
                  hb_interval_s: float = 0.3, hb_timeout_s: float = 2.5):
-        self.root = store_path
+        self.store = (store_path if not isinstance(store_path, str)
+                      else make_store(store_path))
         self.node = str(node_id)
         self.slots = int(slots)
         self.settle_s = settle_s
         self.decide_grace_s = decide_grace_s
         self.hb_interval_s = hb_interval_s
         self.hb_timeout_s = hb_timeout_s
+        # restart handover window: must exceed ClusterElasticAgent._kill's
+        # 5s SIGTERM deadline so restarting members can re-announce
+        self.restart_grace_s = 8.0
         self._last_hb = 0.0
-        os.makedirs(self.root, exist_ok=True)
-
-    # -- paths -------------------------------------------------------------
-    def _gdir(self, gen: int) -> str:
-        d = os.path.join(self.root, f"gen_{gen}")
-        os.makedirs(d, exist_ok=True)
-        return d
 
     # -- membership --------------------------------------------------------
     def members(self, gen: int) -> Dict[str, int]:
         out = {}
-        d = self._gdir(gen)
-        for fn in os.listdir(d):
-            if fn.startswith("member_"):
-                try:
-                    with open(os.path.join(d, fn)) as f:
-                        out[fn[len("member_"):-len(".json")]] = \
-                            json.load(f)["slots"]
-                except (OSError, ValueError):
-                    pass                       # mid-write: next poll sees it
+        for key in self.store.list(f"gen_{gen}/member_"):
+            val = self.store.get(key)
+            if val is not None:
+                name = key.rsplit("/", 1)[-1]
+                out[name[len("member_"):-len(".json")]] = val["slots"]
         return out
 
     def join(self, gen: int, valid_worlds: Sequence[int],
@@ -92,19 +79,18 @@ class FileRendezvous:
         """Announce, settle, decide (or read the decision). Returns
         {"members": [...], "counts": {node: n_workers},
         "world_size": W, "offsets": {node: first_rank}}."""
-        d = self._gdir(gen)
-        _atomic_write(os.path.join(d, f"member_{self.node}.json"),
-                      {"slots": self.slots, "ts": time.time()})
+        self.store.set(f"gen_{gen}/member_{self.node}.json",
+                       {"slots": self.slots, "ts": time.time()})
         self.heartbeat(gen)
-        decision_path = os.path.join(d, "decision.json")
+        decision_key = f"gen_{gen}/decision.json"
         deadline = time.monotonic() + timeout_s
         last_count, settled_at = 0, time.monotonic()
         announced_at = time.monotonic()
         while time.monotonic() < deadline:
             self.heartbeat(gen)
-            if os.path.exists(decision_path):
-                with open(decision_path) as f:
-                    return json.load(f)
+            dec = self.store.get(decision_key)
+            if dec is not None:
+                return dec
             mem = self.members(gen)
             if len(mem) != last_count:
                 last_count, settled_at = len(mem), time.monotonic()
@@ -113,11 +99,27 @@ class FileRendezvous:
             grace = (time.monotonic() - announced_at
                      >= self.settle_s + self.decide_grace_s)
             if settled and mem and (leader or grace):
-                # leader decides; after the grace window anyone may (the
-                # leader may have died between announce and decide)
-                dec = self.decide(mem, valid_worlds)
-                if dec is not None:
-                    _atomic_write(decision_path, dec)
+                # the gate is only consulted when a decision would
+                # otherwise be published — it costs several store reads,
+                # which matters at this loop's 20 Hz on networked stores
+                if self.prev_generation_open(gen):
+                    # the previous generation is still running or mid-
+                    # handover: a late joiner must not self-elect in an
+                    # (as-yet) underpopulated g+1 and split-brain the
+                    # store — wait it out (deadline extended while the
+                    # active generation stays live).
+                    deadline = max(deadline,
+                                   time.monotonic() + self.hb_timeout_s * 4)
+                else:
+                    # leader decides; after the grace window anyone may
+                    # (the leader may have died between announce and
+                    # decide). First-wins publish: if a peer that
+                    # observed different membership raced us, whoever
+                    # linked first is THE decision and the loser re-reads
+                    # it on the next poll.
+                    dec = self.decide(mem, valid_worlds)
+                    if dec is not None:
+                        self.store.setnx(decision_key, dec)
             time.sleep(0.05)
         raise ElasticityError(
             f"rendezvous generation {gen} timed out after {timeout_s}s "
@@ -140,45 +142,79 @@ class FileRendezvous:
         return {"members": sorted(members), "counts": counts,
                 "offsets": offsets, "world_size": world}
 
+    def prev_generation_open(self, gen: int) -> bool:
+        """True while generation gen-1 is still actively running OR
+        handing over: its decision exists and either (a) it is neither
+        restarting nor all-done and at least one member still heartbeats,
+        or (b) it IS restarting but its members have not all re-announced
+        in gen yet (they spend several seconds SIGTERM-killing workers
+        first — deciding gen in that window would capture it without
+        them; a grace window caps the wait so dead nodes cannot block
+        forever). Gating decisions on this prevents the split-brain
+        where a late joiner, alone in an empty g+1, elects itself and
+        launches a second concurrent world (advisor r4, medium; the
+        restart-handover hole was the r5 review's finding)."""
+        prev = gen - 1
+        if prev < 1:
+            return False
+        dec = self.store.get(f"gen_{prev}/decision.json")
+        if dec is None:
+            return False                    # never decided: nothing to wait on
+        members = dec.get("members", [])
+        restart = self.store.get(f"gen_{prev}/restart")
+        if restart is not None:
+            # handover: closed until every prev member re-announced in
+            # gen, or until the restart grace (worker-kill deadline plus
+            # settle headroom) has elapsed
+            announced = self.members(gen)
+            if all(n in announced for n in members):
+                return False
+            ts = restart.get("ts", 0.0)
+            return time.time() - ts <= self.restart_grace_s
+        if all(self.store.exists(f"gen_{prev}/done_{n}") for n in members):
+            return False                    # finished cleanly
+        for node in members:
+            hb = self.store.get(f"gen_{prev}/hb_{node}")
+            if hb is not None and \
+                    time.time() - hb["ts"] <= self.hb_timeout_s:
+                return True                 # somebody is still alive in it
+        return False                        # everyone in it is dead/stale
+
     # -- liveness / signals ------------------------------------------------
     def heartbeat(self, gen: int) -> None:
         now = time.monotonic()
         if now - self._last_hb < self.hb_interval_s:
             return
         self._last_hb = now
-        _atomic_write(os.path.join(self._gdir(gen), f"hb_{self.node}"),
-                      {"ts": time.time()})
+        self.store.set(f"gen_{gen}/hb_{self.node}", {"ts": time.time()})
 
     def stale_peers(self, gen: int, members: Sequence[str]) -> List[str]:
-        d = self._gdir(gen)
         out = []
         for node in members:
             if node == self.node:
                 continue
-            p = os.path.join(d, f"hb_{node}")
-            try:
-                with open(p) as f:
-                    ts = json.load(f)["ts"]
-            except (OSError, ValueError):
-                ts = 0.0
+            hb = self.store.get(f"gen_{gen}/hb_{node}")
+            ts = hb["ts"] if hb is not None else 0.0
             if time.time() - ts > self.hb_timeout_s:
                 out.append(node)
         return out
 
     def signal_restart(self, gen: int, reason: str) -> None:
-        _atomic_write(os.path.join(self._gdir(gen), "restart"),
-                      {"by": self.node, "reason": reason})
+        # first-wins: the recorded reason is the restart's actual trigger,
+        # not whichever node happened to write last (ts anchors the
+        # handover grace window in prev_generation_open)
+        self.store.setnx(f"gen_{gen}/restart",
+                         {"by": self.node, "reason": reason,
+                          "ts": time.time()})
 
     def restart_requested(self, gen: int) -> bool:
-        return os.path.exists(os.path.join(self._gdir(gen), "restart"))
+        return self.store.exists(f"gen_{gen}/restart")
 
     def mark_done(self, gen: int) -> None:
-        _atomic_write(os.path.join(self._gdir(gen), f"done_{self.node}"),
-                      {"ts": time.time()})
+        self.store.set(f"gen_{gen}/done_{self.node}", {"ts": time.time()})
 
     def all_done(self, gen: int, members: Sequence[str]) -> bool:
-        d = self._gdir(gen)
-        return all(os.path.exists(os.path.join(d, f"done_{n}"))
+        return all(self.store.exists(f"gen_{gen}/done_{n}")
                    for n in members)
 
 

@@ -1,0 +1,236 @@
+"""Rendezvous stores: shared-directory (default) and TCP.
+
+The rendezvous protocol (`rendezvous.py`) needs five primitives —
+last-wins write, FIRST-wins write, read, prefix list, exists. The
+default ``DirectoryStore`` maps them onto a shared filesystem (TPU pods
+mount NFS/GCS-fuse); ``TCPStore`` removes that requirement the way the
+reference's torch-elastic rdzv backend does
+(`/root/reference/deepspeed/elasticity/elastic_agent.py:23` rides
+c10d's TCPStore): one agent hosts a tiny key-value server, everyone
+else connects. Addresses look like ``tcp://host:port`` (client) or
+``tcp://host:port?master=1`` (host the server in-process if nothing is
+listening yet).
+
+Protocol: one JSON object per line, one request per connection round:
+  {"op": "set"|"setnx"|"get"|"list"|"ping", "key": ..., "val": ...}
+→ {"ok": bool, "val": ..., "keys": [...]}
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+
+def _atomic_write(path: str, data: Dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(data, f)
+    try:
+        os.rename(tmp, path)
+    except OSError:
+        os.unlink(tmp)
+
+
+class DirectoryStore:
+    """Keys are slash-separated relative paths under ``root``."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _p(self, key: str) -> str:
+        return os.path.join(self.root, key)
+
+    def _p_mkdir(self, key: str) -> str:
+        # only WRITES create directories — reads/exists run at 20 Hz in
+        # the rendezvous poll loop, and a makedirs per read is real
+        # metadata traffic on the NFS/GCS-fuse mounts this store targets
+        path = self._p(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        return path
+
+    def set(self, key: str, val: Dict) -> None:
+        _atomic_write(self._p_mkdir(key), val)
+
+    def setnx(self, key: str, val: Dict) -> bool:
+        """First writer wins (os.link refuses to replace)."""
+        path = self._p_mkdir(key)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(val, f)
+        try:
+            os.link(tmp, path)
+            return True
+        except OSError:
+            return False
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def get(self, key: str) -> Optional[Dict]:
+        try:
+            with open(self._p(key)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None                     # absent or mid-write
+
+    def list(self, prefix: str) -> List[str]:
+        d, _, name_pre = prefix.rpartition("/")
+        try:
+            names = os.listdir(os.path.join(self.root, d))
+        except OSError:
+            return []
+        return [f"{d}/{n}" if d else n
+                for n in names if n.startswith(name_pre)
+                and ".tmp." not in n]
+
+    def exists(self, key: str) -> bool:
+        return os.path.exists(self._p(key))
+
+
+class _KV:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.data: Dict[str, Dict] = {}
+
+    def handle(self, req: Dict) -> Dict:
+        op, key = req.get("op"), req.get("key", "")
+        with self.lock:
+            if op == "set":
+                self.data[key] = req.get("val")
+                return {"ok": True}
+            if op == "setnx":
+                if key in self.data:
+                    return {"ok": False}
+                self.data[key] = req.get("val")
+                return {"ok": True}
+            if op == "get":
+                return {"ok": key in self.data, "val": self.data.get(key)}
+            if op == "list":
+                pre = req.get("key", "")
+                return {"ok": True,
+                        "keys": [k for k in self.data if k.startswith(pre)]}
+            if op == "ping":
+                return {"ok": True}
+        return {"ok": False, "error": f"bad op {op!r}"}
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        for line in self.rfile:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                resp = self.server.kv.handle(json.loads(line))
+            except ValueError:
+                resp = {"ok": False, "error": "bad json"}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def serve_store(host: str = "127.0.0.1", port: int = 0) -> "_Server":
+    """Host a store server (daemon thread); returns the server object
+    (``server.server_address`` carries the bound port)."""
+    srv = _Server((host, port), _Handler)
+    srv.kv = _KV()
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+class TCPStore:
+    """Client of a store server; with ``master=True`` hosts one
+    in-process first if nothing is listening at the address yet."""
+
+    def __init__(self, host: str, port: int, master: bool = False,
+                 timeout_s: float = 10.0):
+        self.addr = (host, port)
+        self.timeout_s = timeout_s
+        self._server = None
+        self._lock = threading.Lock()
+        self._sock = None
+        self._rfile = None
+        if master and not self._listening():
+            try:
+                self._server = serve_store(host, port)
+            except OSError:
+                pass                    # lost the bind race: peer hosts it
+        deadline = time.monotonic() + timeout_s
+        while not self._listening():
+            if time.monotonic() > deadline:
+                raise ConnectionError(
+                    f"rendezvous store at {host}:{port} is not reachable "
+                    f"(start an agent with tcp://{host}:{port}?master=1)")
+            time.sleep(0.1)
+
+    def _listening(self) -> bool:
+        try:
+            with socket.create_connection(self.addr, timeout=1.0):
+                return True
+        except OSError:
+            return False
+
+    def _rpc(self, req: Dict) -> Dict:
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    if self._sock is None:
+                        self._sock = socket.create_connection(
+                            self.addr, timeout=self.timeout_s)
+                        self._rfile = self._sock.makefile("rb")
+                    self._sock.sendall(
+                        (json.dumps(req) + "\n").encode())
+                    line = self._rfile.readline()
+                    if not line:
+                        raise ConnectionError("store closed connection")
+                    return json.loads(line)
+                except (OSError, ValueError):
+                    try:
+                        if self._sock is not None:
+                            self._sock.close()
+                    finally:
+                        self._sock = None
+                        self._rfile = None
+                    if attempt:
+                        raise
+        raise ConnectionError("unreachable")        # pragma: no cover
+
+    def set(self, key: str, val: Dict) -> None:
+        self._rpc({"op": "set", "key": key, "val": val})
+
+    def setnx(self, key: str, val: Dict) -> bool:
+        return self._rpc({"op": "setnx", "key": key, "val": val})["ok"]
+
+    def get(self, key: str) -> Optional[Dict]:
+        r = self._rpc({"op": "get", "key": key})
+        return r.get("val") if r.get("ok") else None
+
+    def list(self, prefix: str) -> List[str]:
+        return self._rpc({"op": "list", "key": prefix}).get("keys", [])
+
+    def exists(self, key: str) -> bool:
+        return self._rpc({"op": "get", "key": key}).get("ok", False)
+
+
+def make_store(path_or_url: str):
+    """``tcp://host:port[?master=1]`` → TCPStore; anything else is a
+    shared-directory path → DirectoryStore."""
+    if path_or_url.startswith("tcp://"):
+        u = urlparse(path_or_url)
+        q = parse_qs(u.query)
+        return TCPStore(u.hostname or "127.0.0.1", int(u.port or 29500),
+                        master=q.get("master", ["0"])[0] in ("1", "true"))
+    return DirectoryStore(path_or_url)

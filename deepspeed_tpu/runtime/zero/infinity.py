@@ -700,7 +700,10 @@ class InfinityStepper:
     def _micro_fwd_bwd(self, progs, ids, labels, mask, tt,
                        on_layer_grad: Callable[[int, Any], None]):
         """One microbatch forward+backward, streaming layer grads into
-        ``on_layer_grad``. Returns (loss, resident_grad_tree_dev, sq_dev)."""
+        ``on_layer_grad``. Returns (loss, resident_grad_tree_dev,
+        res_sq_dev, total_sq_dev); total_sq's block-grad terms are
+        PRE-quantization when the wire codec is active (the decoded norm
+        is recomputed host-side in that case)."""
         zero_i = jnp.zeros((1, 1), jnp.int32)
         ids_dev = jax.device_put(np.asarray(ids), self._batch_shard)
         labels_dev = (jax.device_put(np.asarray(labels), self._batch_shard)
@@ -741,7 +744,7 @@ class InfinityStepper:
         d_res_embed = progs["embed_vjp"](self.resident, ids_dev, tt_dev, dy)
         d_res, res_sq = progs["res_combine"](d_res_head, d_res_embed)
         total_sq = res_sq + sum(sqs)
-        return loss, d_res, total_sq
+        return loss, d_res, res_sq, total_sq
 
     # ------------------------------------------------------------------
     # optimizer application
@@ -753,6 +756,10 @@ class InfinityStepper:
         if self.wire_bits:
             g32 = np.empty(self.n_local, np.float32)
             self._decode_wire(wire, g32, accumulate=False)
+            # the reported grad_norm must describe the grads actually
+            # APPLIED — the stochastically-rounded decode, not the
+            # pre-quantization device values (advisor r4, low)
+            self._layer_sq[i] = float(np.dot(g32, g32))
             g = g32
         else:
             g = self._fetch_flat(wire).view(np.uint16)  # bf16 wire format
@@ -849,6 +856,7 @@ class InfinityStepper:
         futures = []
         loss_total = 0.0
         sq_total = 0.0
+        res_sq_total = 0.0
         res_acc = None
         self._dev.clear()
         if not pure_stream and self._grad_accum is None:
@@ -881,12 +889,13 @@ class InfinityStepper:
             else:
                 def on_grad(i, dflat):
                     futures.append(self._submit(i, self._accum_layer, dflat))
-            loss, d_res, sq = self._micro_fwd_bwd(
+            loss, d_res, res_sq, sq = self._micro_fwd_bwd(
                 progs, ids[j],
                 labels[j] if labels is not None else None,
                 mask[j] if mask is not None else None,
                 tt[j] if tt is not None else None, on_grad)
             loss_total += float(loss)
+            res_sq_total += float(res_sq)
             sq_total += float(sq)
             res_acc = d_res if res_acc is None else self._res_add(res_acc,
                                                                  d_res)
@@ -900,8 +909,20 @@ class InfinityStepper:
 
         grad_scale = float(gas)
         if pure_stream:
-            # gas==1: Σ per-layer ||g||² IS the exact squared norm
-            gnorm = math.sqrt(sq_total)
+            if self.wire_bits:
+                # the applied grads are the stochastically-rounded wire
+                # decode: report THEIR norm (recorded per layer by
+                # _step_layer), not the pre-quantization device values
+                block_sq = float(np.sum(self._layer_sq))
+                if jax.process_count() > 1:
+                    from jax.experimental import multihost_utils
+                    block_sq = float(np.sum(
+                        multihost_utils.process_allgather(
+                            np.float32(block_sq))))
+                gnorm = math.sqrt(res_sq_total + block_sq)
+            else:
+                # gas==1: Σ per-layer ||g||² IS the exact squared norm
+                gnorm = math.sqrt(sq_total)
         else:
             # exact norm of the ACCUMULATED grads (clipping must see the
             # true norm — reference runtime/utils.py:325 clip_grad_norm_);
