@@ -5,14 +5,24 @@ Role-equivalent of the reference's fused ``softmax_context`` inference kernel
 ``attention_unfused`` dispatch in `pt_binding.cpp`): one query token attends
 over the KV cache with a validity mask, softmax fused in-kernel.
 
-TPU design: one grid step per (batch, head). The whole KV slice for that
-head lives in VMEM (S·D ≤ a few MB for any practical cache), so no online
-softmax is needed — a single masked softmax over the cache axis. The valid
-length arrives as a scalar-prefetch operand (SMEM), so one compiled kernel
-serves every decode position.
+TPU design (round 5 — r4 ran at 6% of HBM bandwidth): decode is a pure
+HBM-bandwidth workload, so the kernel consumes the cache in its NATIVE
+``[B, S, H, D]`` layout — the hot loop DMAs contiguous ``[chunk, H, D]``
+slabs (every byte sequential in HBM) and computes ALL heads per chunk.
+The r4 kernel wanted ``[B*H, S, D]``, which forced a full materialized
+transpose of the cache per decode step (2x the cache size in extra HBM
+traffic) and left the kernel itself reading 256-byte strided rows.
+
+Online softmax runs per head with state in ``[1, H]`` row orientation;
+row-scaling of the ``[H, D]`` accumulator by a ``[1, H]`` vector is done
+as a ``diag(alpha) @ acc`` matmul (a 16x16 MXU op) — Mosaic has no cheap
+[1,H]->[H,1] relayout, and this keeps the kernel transpose-free.
+
+The valid length arrives as a scalar-prefetch operand (SMEM), so one
+compiled kernel serves every decode position.
 
 Layout contract: q [B, H, D] (the single new token), k/v [B, S, H, D]
-(the cache); returns [B, H, D].
+(the cache, exactly as the model stores it); returns [B, H, D].
 """
 from __future__ import annotations
 
@@ -33,70 +43,66 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale):
-    # q_ref [1, D]; k_ref/v_ref [S, D]; len_ref SMEM [1]
-    q = q_ref[...].astype(jnp.float32)            # [1, D]
-    k = k_ref[...].astype(jnp.float32)            # [S, D]
-    s = k.shape[0]
-    scores = jnp.dot(k, q.T,
-                     preferred_element_type=jnp.float32) * sm_scale  # [S, 1]
-    pos = jax.lax.broadcasted_iota(jnp.int32, (s, 1), 0)
-    scores = jnp.where(pos < len_ref[0], scores, MASK_VALUE)
-    m = jnp.max(scores, axis=0, keepdims=True)
-    p = jnp.exp(scores - m)
-    denom = jnp.sum(p, axis=0, keepdims=True)
-    v = v_ref[...].astype(jnp.float32)            # [S, D]
-    out = jnp.dot(p.T, v, preferred_element_type=jnp.float32) / denom  # [1,D]
-    o_ref[...] = out.astype(o_ref.dtype)
+def _rowscale(vec_1h, mat_hd):
+    """Scale row h of ``mat_hd`` [H, D] by ``vec_1h`` [1, H]: build
+    diag(vec) with 2-D iotas and contract on the MXU — no relayout."""
+    h = mat_hd.shape[0]
+    r = jax.lax.broadcasted_iota(jnp.int32, (h, h), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (h, h), 1)
+    diag = jnp.where(r == c, jnp.broadcast_to(vec_1h, (h, h)), 0.0)
+    # HIGHEST: default matmul precision truncates f32 operands to bf16
+    # passes, which would put a bf16 round on every accumulator rescale
+    return jnp.dot(diag, mat_hd, preferred_element_type=jnp.float32,
+                   precision=jax.lax.Precision.HIGHEST)
 
 
-def _kernel_chunked(len_ref, q_ref, k_ref, v_ref, o_ref,
-                    m_scr, l_scr, acc_scr, *, sm_scale, chunk):
-    """Online-softmax decode over KV CHUNKS (the flash recurrence with one
-    query row): lifts the whole-cache-in-VMEM bound of `_kernel` — the
-    16k+-token serving path (VERDICT r2 weak #5)."""
+def _kernel_heads(len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, sm_scale, chunk):
+    """Online-softmax decode over KV chunks, ALL heads per chunk.
+
+    q_ref [H, D]; k_ref/v_ref [chunk, H, D] (contiguous HBM slab);
+    o_ref [H, D]; scratch: m/l [1, H], acc [H, D]."""
     c = pl.program_id(1)
     nc = pl.num_programs(1)
 
     @pl.when(c == 0)
     def _init():
-        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
     run = c * chunk < len_ref[0]
 
     @pl.when(run)
     def _body():
-        q = q_ref[...].astype(jnp.float32)        # [1, D]
-        k = k_ref[...].astype(jnp.float32)        # [chunk, D]
-        scores = jnp.dot(k, q.T,
-                         preferred_element_type=jnp.float32) * sm_scale
-        pos = c * chunk + jax.lax.broadcasted_iota(jnp.int32,
-                                                   scores.shape, 0)
+        q = q_ref[...].astype(jnp.float32)            # [H, D]
+        k = k_ref[...].astype(jnp.float32)            # [chunk, H, D]
+        scores = jnp.sum(k * q[None], axis=-1) * sm_scale    # [chunk, H]
+        pos = c * chunk + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 0)
         scores = jnp.where(pos < len_ref[0], scores, MASK_VALUE)
-        # scalar state lives broadcast across full tiles — Mosaic has no
-        # scalar VMEM stores; reduce-to-scalar reads, full-tile writes
-        m_prev = jnp.max(m_scr[...])
-        l_prev = jnp.max(l_scr[...])
-        m_new = jnp.maximum(m_prev, jnp.max(scores))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(scores - m_new)               # [chunk, 1]
-        l_scr[...] = jnp.full_like(l_scr, alpha * l_prev + jnp.sum(p))
-        v = v_ref[...].astype(jnp.float32)        # [chunk, D]
-        acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
-            p.T, v, preferred_element_type=jnp.float32)
-        m_scr[...] = jnp.full_like(m_scr, m_new)
+        m_prev = m_scr[...]                           # [1, H]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(scores, axis=0, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)               # [1, H]
+        p = jnp.exp(scores - m_new)                   # [chunk, H]
+        l_scr[...] = alpha * l_scr[...] + jnp.sum(p, axis=0,
+                                                  keepdims=True)
+        v = v_ref[...].astype(jnp.float32)            # [chunk, H, D]
+        pv = jnp.sum(p[:, :, None] * v, axis=0)       # [H, D]
+        acc_scr[...] = _rowscale(alpha, acc_scr[...]) + pv
+        m_scr[...] = m_new
 
     @pl.when(c == nc - 1)
     def _out():
-        o_ref[...] = (acc_scr[:1] / jnp.max(l_scr[...])).astype(o_ref.dtype)
+        inv = 1.0 / jnp.maximum(l_scr[...], 1e-30)    # [1, H]
+        o_ref[...] = _rowscale(inv, acc_scr[...]).astype(o_ref.dtype)
 
 
-# per-head KV slice budget for the single-block kernel: 2 operands x fp32
-# in-kernel copies ≤ ~6 MB of the ~16 MB VMEM
-_SINGLE_BLOCK_BUDGET = 6 * 2 ** 20
-_CHUNK = 2048
+# [chunk, H, D] slabs: 2 operands x bf16 x double-buffering + f32
+# in-kernel copies must fit ~16 MB VMEM; 256 rows x 16 heads x 128 dim
+# = 1 MB/operand-block keeps everything comfortable
+_CHUNK_ELEMS = 256 * 16 * 128
 
 
 def decode_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -106,8 +112,9 @@ def decode_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """q [B, H, D], k/v [B, S, H, D], length: int32 scalar (valid cache
     prefix, i.e. index of the new token + 1). Returns [B, H, D].
 
-    Small caches run the one-shot kernel; caches beyond the VMEM budget
-    run the chunked online-softmax kernel — any ``max_out_tokens``."""
+    One unified kernel for any cache length: KV streams through VMEM in
+    contiguous [chunk, H, D] slabs with online softmax, so there is no
+    whole-cache VMEM bound and no layout change on the way in."""
     b, h, d = q.shape
     s = k.shape[1]
     if sm_scale is None:
@@ -115,64 +122,52 @@ def decode_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if interpret is None:
         interpret = _interpret_default()
 
-    qf = q.reshape(b * h, 1, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    length = jnp.asarray(length, jnp.int32).reshape(1)
-
-    if s * d * 16 <= _SINGLE_BLOCK_BUDGET:
-        out = pl.pallas_call(
-            functools.partial(_kernel, sm_scale=sm_scale),
-            grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=1,
-                grid=(b * h,),
-                in_specs=[
-                    pl.BlockSpec((None, 1, d), lambda i, *_: (i, 0, 0)),
-                    pl.BlockSpec((None, s, d), lambda i, *_: (i, 0, 0)),
-                    pl.BlockSpec((None, s, d), lambda i, *_: (i, 0, 0)),
-                ],
-                out_specs=pl.BlockSpec((None, 1, d),
-                                       lambda i, *_: (i, 0, 0)),
-            ),
-            out_shape=jax.ShapeDtypeStruct((b * h, 1, d), q.dtype),
-            interpret=interpret,
-        )(length, qf, kf, vf)
-        return out.reshape(b, h, d)
-
-    chunk = _CHUNK
+    # chunk: contiguous rows per DMA slab, scaled so slab bytes stay
+    # constant as H*D varies, then rounded DOWN to a power of two so the
+    # usual power-of-two cache lengths divide exactly — a non-dividing
+    # chunk would jnp.pad (full-copy!) the entire cache every step
+    chunk = max(8, min(1024, _CHUNK_ELEMS // (h * d)))
+    chunk = 1 << (chunk.bit_length() - 1)
+    if s < chunk:
+        chunk = max(8, s)      # single-slab case: pad cost is one slab
     if s % chunk:
         pad = chunk - s % chunk
-        kf = jnp.pad(kf, ((0, 0), (0, pad), (0, 0)))
-        vf = jnp.pad(vf, ((0, 0), (0, pad), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
         s = s + pad
     nc = s // chunk
+    length = jnp.asarray(length, jnp.int32).reshape(1)
+
     out = pl.pallas_call(
-        functools.partial(_kernel_chunked, sm_scale=sm_scale, chunk=chunk),
+        functools.partial(_kernel_heads, sm_scale=sm_scale, chunk=chunk),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(b * h, nc),
+            grid=(b, nc),
             in_specs=[
-                pl.BlockSpec((None, 1, d), lambda i, c, *_: (i, 0, 0)),
-                pl.BlockSpec((None, chunk, d), lambda i, c, *_: (i, c, 0)),
-                pl.BlockSpec((None, chunk, d), lambda i, c, *_: (i, c, 0)),
+                pl.BlockSpec((None, h, d), lambda i, c, *_: (i, 0, 0)),
+                pl.BlockSpec((None, chunk, h, d),
+                             lambda i, c, *_: (i, c, 0, 0)),
+                pl.BlockSpec((None, chunk, h, d),
+                             lambda i, c, *_: (i, c, 0, 0)),
             ],
-            out_specs=pl.BlockSpec((None, 1, d), lambda i, c, *_: (i, 0, 0)),
+            out_specs=pl.BlockSpec((None, h, d),
+                                   lambda i, c, *_: (i, 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((8, 128), jnp.float32),
-                pltpu.VMEM((8, 128), jnp.float32),
-                pltpu.VMEM((8, d), jnp.float32),
+                pltpu.VMEM((1, h), jnp.float32),
+                pltpu.VMEM((1, h), jnp.float32),
+                pltpu.VMEM((h, d), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(length, qf, kf, vf)
-    return out.reshape(b, h, d)
+    )(length, q, k, v)
+    return out
 
 
 def supports(head_dim: int, cache_len: int) -> bool:
-    """Lane-aligned head dim keeps the MXU fed; cache length is unbounded
-    (the chunked kernel streams KV chunks through VMEM)."""
+    """Lane-aligned head dim keeps the VPU/MXU fed; cache length is
+    unbounded (the kernel streams KV slabs through VMEM)."""
     del cache_len
     return head_dim % 8 == 0

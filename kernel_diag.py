@@ -1,0 +1,102 @@
+"""One-off diagnostic: true kernel times with in-program chaining.
+
+Itemizes the blocksparse ~100ms floor (VERDICT r4 weak #3) and the decode
+bandwidth (weak #4) by timing each kernel inside a single compiled
+fori_loop — no per-dispatch tunnel latency in the measurement at all.
+"""
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def sync(a):
+    leaf = jax.tree_util.tree_leaves(a)[0]
+    np.asarray(jax.device_get(leaf.reshape(-1)[:1]))
+
+
+def timed(fn, *args, reps=3, inner=64):
+    r = fn(*args)
+    sync(r)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        r = fn(*args)
+        sync(r)
+        best = min(best, time.perf_counter() - t0)
+    return best / inner * 1000
+
+
+def decode_diag():
+    from deepspeed_tpu.ops.transformer.decode_attention import (
+        decode_attention)
+    b, h, d, cache = 4, 16, 128, 16384
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(b, h, d), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(b, cache, h, d), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(b, cache, h, d), jnp.bfloat16)
+
+    @jax.jit
+    def chain(q, k, v):
+        def body(i, qq):
+            return decode_attention(qq, k, v, cache)
+        return jax.lax.fori_loop(0, 64, body, q)
+
+    ms = timed(chain, q, k, v)
+    gb = (k.nbytes + v.nbytes) / 2**30
+    print(json.dumps({"kernel": "decode_16k", "ms": round(ms, 3),
+                      "achieved_gbps": round(gb / (ms / 1e3), 1)}),
+          flush=True)
+
+
+def attn_diag():
+    from deepspeed_tpu.ops.sparse_attention import (
+        LocalSlidingWindowSparsityConfig, blocksparse_attention_bthd)
+    from deepspeed_tpu.ops.transformer.flash_attention import (
+        flash_attention_bthd)
+    heads, d = 8, 128
+
+    def run_case(name, f, s, fwd_only=False):
+        rs = np.random.RandomState(0)
+        q = jnp.asarray(rs.randn(1, s, heads, d), jnp.bfloat16)
+        k = jnp.asarray(rs.randn(1, s, heads, d), jnp.bfloat16)
+        v = jnp.asarray(rs.randn(1, s, heads, d), jnp.bfloat16)
+
+        if fwd_only:
+            @jax.jit
+            def chain(q, k, v):
+                def body(i, qq):
+                    o = f(qq, k, v)
+                    return o.astype(qq.dtype)
+                return jax.lax.fori_loop(0, 64, body, q)
+        else:
+            g = jax.grad(lambda q, k, v: jnp.sum(
+                f(q, k, v).astype(jnp.float32) ** 2))
+
+            @jax.jit
+            def chain(q, k, v):
+                def body(i, qq):
+                    return g(qq, k, v).astype(qq.dtype)
+                return jax.lax.fori_loop(0, 64, body, q)
+
+        ms = timed(chain, q, k, v)
+        print(json.dumps({"kernel": name, "seq": s, "ms": round(ms, 2)}),
+              flush=True)
+        return ms
+
+    for s in (2048, 4096, 8192, 16384):
+        scfg = LocalSlidingWindowSparsityConfig(
+            num_heads=heads, block=512, num_sliding_window_blocks=3)
+        bs = lambda q, k, v: blocksparse_attention_bthd(q, k, v, scfg)  # noqa
+        fl = lambda q, k, v: flash_attention_bthd(q, k, v, causal=True)  # noqa
+        run_case("blocksparse_fwd", bs, s, fwd_only=True)
+        run_case("blocksparse_fwdbwd", bs, s)
+        run_case("flash_fwd", fl, s, fwd_only=True)
+        run_case("flash_fwdbwd", fl, s)
+
+
+if __name__ == "__main__":
+    decode_diag()
+    attn_diag()
