@@ -1,6 +1,6 @@
 """Compression — counterpart of `/root/reference/deepspeed/compression/`."""
-from .compress import (ActivationQuantConfig, CompressionConfig,
-                       HeadPruningConfig, LayerReductionConfig,
+from .compress import (ActivationQuantConfig, ChannelPruningConfig,
+                       CompressionConfig, HeadPruningConfig, LayerReductionConfig,
                        MovementPruningModel, PruningGroup, RowPruningConfig,
                        SparsePruningConfig, WeightQuantizeConfig,
                        add_movement_scores, apply_layer_reduction,
@@ -10,7 +10,8 @@ from .compress import (ActivationQuantConfig, CompressionConfig,
                        parse_compression_config, post_training_quantize,
                        redundancy_clean, topk_mask)
 
-__all__ = ["ActivationQuantConfig", "CompressionConfig", "HeadPruningConfig",
+__all__ = ["ActivationQuantConfig", "ChannelPruningConfig",
+           "CompressionConfig", "HeadPruningConfig",
            "LayerReductionConfig", "MovementPruningModel", "PruningGroup",
            "RowPruningConfig", "SparsePruningConfig", "WeightQuantizeConfig",
            "add_movement_scores", "apply_layer_reduction", "bits_at_step",

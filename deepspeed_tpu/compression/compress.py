@@ -82,6 +82,17 @@ class HeadPruningConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ChannelPruningConfig:
+    """Prune conv OUTPUT channels (reference `enable_channel_pruning`,
+    compression/basic_layer.py:503) — targets the 4-D [kh, kw, cin, cout]
+    kernels of the conv family (models/diffusion.py UNet/VAE)."""
+    enabled: bool = False
+    method: str = "l1"
+    schedule_offset: int = 0
+    groups: Sequence[PruningGroup] = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class ActivationQuantConfig:
     enabled: bool = False
     bits: int = 8
@@ -107,6 +118,7 @@ class CompressionConfig:
     sparse_pruning: SparsePruningConfig = SparsePruningConfig()
     row_pruning: RowPruningConfig = RowPruningConfig()
     head_pruning: HeadPruningConfig = HeadPruningConfig()
+    channel_pruning: ChannelPruningConfig = ChannelPruningConfig()
     activation_quantization: ActivationQuantConfig = ActivationQuantConfig()
     layer_reduction: LayerReductionConfig = LayerReductionConfig()
 
@@ -114,7 +126,8 @@ class CompressionConfig:
     def any_param_transform(self) -> bool:
         return (self.weight_quantization.enabled
                 or self.sparse_pruning.enabled or self.row_pruning.enabled
-                or self.head_pruning.enabled)
+                or self.head_pruning.enabled
+                or self.channel_pruning.enabled)
 
 
 # ---------------------------------------------------------------------------
@@ -153,13 +166,9 @@ def _parse_pruning(block: Dict, cls, ratio_key: str, **extra):
     shared = block.get("shared_parameters", block)
     enabled = bool(shared.get("enabled", False))
     method = shared.get("method", "l1")
-    if enabled and method == "topk" and cls is not SparsePruningConfig:
-        raise NotImplementedError(
-            f"{cls.__name__}: method='topk' (movement pruning) is built "
-            f"for sparse_pruning (per-element trainable scores — the "
-            f"reference's TopKBinarizer scope, compression/utils.py:6); "
-            f"row/head pruning are structural L1 decisions, use "
-            f"method='l1'")
+    if enabled and method not in ("l1", "topk"):
+        raise ValueError(f"{cls.__name__}: unknown method '{method}' "
+                         f"(l1 | topk)")
     groups = _parse_groups(block, ratio_key)
     if not groups and "dense_ratio" in shared:
         groups = [PruningGroup(dense_ratio=float(shared["dense_ratio"]),
@@ -176,17 +185,6 @@ def _parse_pruning(block: Dict, cls, ratio_key: str, **extra):
 
 def parse_compression_config(d: Dict[str, Any]) -> CompressionConfig:
     d = d or {}
-    if d.get("channel_pruning", {}).get("shared_parameters",
-                                        d.get("channel_pruning", {})
-                                        ).get("enabled"):
-        raise NotImplementedError(
-            "channel_pruning targets conv channels during TRAINING; the "
-            "compression pipeline wraps the LM training loss, and the "
-            "conv family here (models/diffusion.py UNet/VAE) is a "
-            "serving-only stack with no training seam to prune through. "
-            "Use row_pruning for transformer feature pruning or "
-            "sparse_pruning for unstructured")
-
     wq_block = d.get("weight_quantization", {})
     if "shared_parameters" in wq_block:
         sp = wq_block["shared_parameters"]
@@ -238,14 +236,6 @@ def parse_compression_config(d: Dict[str, Any]) -> CompressionConfig:
             "static activation ranges are symmetric-absmax "
             "(fake_quantize_static); set quantization_type='symmetric' "
             "or use dynamic calibration for the asymmetric path")
-    if aq.enabled and aq.schedule_offset:
-        raise NotImplementedError(
-            "activation_quantization schedule_offset is not honored — the "
-            "act-quant seam is a static model flag with no step input; "
-            "quantization would run from step 0. Remove the offset, or "
-            "train full-precision first and enable act quant for the "
-            "finetune phase")
-
     lr_block = d.get("layer_reduction", {})
     lr = LayerReductionConfig(
         enabled=bool(lr_block.get("enabled", False)),
@@ -270,6 +260,8 @@ def parse_compression_config(d: Dict[str, Any]) -> CompressionConfig:
                 d.get("head_pruning", {}).get("shared_parameters",
                                               d.get("head_pruning", {}))
                 .get("num_heads", 0))),
+        channel_pruning=_parse_pruning(d.get("channel_pruning", {}),
+                                       ChannelPruningConfig, "dense_ratio"),
         activation_quantization=aq,
         layer_reduction=lr)
 
@@ -317,31 +309,68 @@ def movement_mask(scores, keep_ratio):
 MASK_SCORES_KEY = "_mask_scores"
 
 
+def _row_scores_init(w):
+    """Per-output-feature L1 norms (also the channel-pruning init: conv
+    kernels reduce [kh, kw, cin] the same way row kernels reduce [in])."""
+    return jnp.sum(jnp.abs(w.astype(jnp.float32)),
+                   axis=tuple(range(w.ndim - 1)))
+
+
+def _head_scores_init(w, nh):
+    return jnp.sum(jnp.abs(w.astype(jnp.float32)).reshape(nh, -1), axis=1)
+
+
 def add_movement_scores(params, cfg) -> Dict:
-    """Attach trainable mask-score leaves for every kernel a topk sparse
-    group targets. Scores initialize to |w| so step 0 reproduces
-    magnitude pruning; training then moves them. Returns a NEW params
-    dict with a ``_mask_scores`` subtree (path-string -> score array)."""
+    """Attach trainable mask-score leaves for every kernel a topk pruning
+    group targets — sparse (per-element, the reference TopKBinarizer's
+    unstructured scope), row/channel (per output feature/channel) and
+    head (per attention head), mirroring the reference applying
+    TopKBinarizer at every one of those scopes (basic_layer.py:159,179,
+    503). Scores initialize to the corresponding L1 statistic so step 0
+    reproduces magnitude pruning; training then moves them. Returns a
+    NEW params dict with a ``_mask_scores`` subtree; row/head/channel
+    score keys are suffixed ``#row``/``#head``/``#channel`` so multiple
+    techniques may target the same kernel."""
     if isinstance(cfg, dict):
         cfg = parse_compression_config(cfg)
-    sp = cfg.sparse_pruning
-    if not (sp.enabled and sp.method == "topk"):
-        raise ValueError("add_movement_scores: sparse_pruning with "
-                         "method='topk' is not enabled in this config")
-    regexes = [re.compile(g.modules or _DEFAULT_SCOPES["sparse"])
-               for g in sp.groups]
+    wants = []        # (suffix, groups, default_scope, init_fn)
+    if cfg.sparse_pruning.enabled and cfg.sparse_pruning.method == "topk":
+        wants.append(("", cfg.sparse_pruning.groups, "sparse",
+                      lambda w: jnp.abs(w).astype(jnp.float32)))
+    if cfg.row_pruning.enabled and cfg.row_pruning.method == "topk":
+        wants.append(("#row", cfg.row_pruning.groups, "row",
+                      _row_scores_init))
+    if cfg.head_pruning.enabled and cfg.head_pruning.method == "topk":
+        nh = cfg.head_pruning.num_heads
+        if nh <= 0:
+            raise ValueError("head_pruning topk needs num_heads")
+        wants.append(("#head", cfg.head_pruning.groups, "head",
+                      lambda w: _head_scores_init(w, nh)))
+    if cfg.channel_pruning.enabled and \
+            cfg.channel_pruning.method == "topk":
+        wants.append(("#channel", cfg.channel_pruning.groups, "channel",
+                      _row_scores_init))
+    if not wants:
+        raise ValueError("add_movement_scores: no pruning technique with "
+                         "method='topk' is enabled in this config")
     scores: Dict[str, jnp.ndarray] = {}
 
     def visit(path, leaf):
         name = _path_str(path)
-        if (leaf.ndim >= 2 and name.endswith("kernel")
-                and any(rx.search(name) for rx in regexes)):
-            scores[name] = jnp.abs(leaf).astype(jnp.float32)
+        if leaf.ndim < 2 or not name.endswith("kernel"):
+            return leaf
+        for suffix, groups, scope, init in wants:
+            rxs = [re.compile(g.modules or _DEFAULT_SCOPES[scope])
+                   for g in groups]
+            if any(rx.search(name) for rx in rxs):
+                stacked = name.startswith("blocks") and suffix
+                scores[name + suffix] = (jax.vmap(init)(leaf) if stacked
+                                         else init(leaf))
         return leaf
     jax.tree_util.tree_map_with_path(visit, params)
     if not scores:
-        raise ValueError("add_movement_scores: no kernel matched the topk "
-                         "sparse_pruning scopes")
+        raise ValueError("add_movement_scores: no kernel matched any topk "
+                         "pruning scope")
     return {**params, MASK_SCORES_KEY: scores}
 
 
@@ -378,6 +407,9 @@ _DEFAULT_SCOPES = {
     "sparse": r"kernel$",
     "row": r"mlp/fc_in/kernel$",
     "head": r"attn/out/kernel$",
+    # the conv family's kernels (models/diffusion.py: conv1/conv2/
+    # conv_shortcut/proj_in/proj_out, all HWIO)
+    "channel": r"(conv[^/]*|proj_in|proj_out)/kernel$",
 }
 
 
@@ -406,7 +438,7 @@ def compress_params(params, cfg, step):
             b //= 2
         levels.append(wq.target_bits)
 
-    prunes = []   # (mask_fn, regex, offset, uses_scores)
+    prunes = []   # (mask_fn, regex, offset, score_suffix|None)
     sp = cfg.sparse_pruning
     for g in (sp.groups if sp.enabled else ()):
         rx = re.compile(g.modules or _DEFAULT_SCOPES["sparse"])
@@ -414,23 +446,53 @@ def compress_params(params, cfg, step):
             prunes.append(
                 (lambda w, s, r=g.dense_ratio:
                  movement_mask(s, r).astype(w.dtype),
-                 rx, sp.schedule_offset, True))
+                 rx, sp.schedule_offset, ""))
         else:
             prunes.append((lambda w, r=g.dense_ratio: _sparse_mask(w, r),
-                           rx, sp.schedule_offset, False))
-    for g in (cfg.row_pruning.groups if cfg.row_pruning.enabled else ()):
-        prunes.append((lambda w, r=g.dense_ratio: _row_mask(w, r),
-                       re.compile(g.modules or _DEFAULT_SCOPES["row"]),
-                       cfg.row_pruning.schedule_offset, False))
+                           rx, sp.schedule_offset, None))
+    rp = cfg.row_pruning
+    for g in (rp.groups if rp.enabled else ()):
+        rx = re.compile(g.modules or _DEFAULT_SCOPES["row"])
+        if rp.method == "topk":
+            prunes.append(
+                (lambda w, s, r=g.dense_ratio:
+                 movement_mask(s, r).astype(w.dtype)[None, :],
+                 rx, rp.schedule_offset, "#row"))
+        else:
+            prunes.append((lambda w, r=g.dense_ratio: _row_mask(w, r),
+                           rx, rp.schedule_offset, None))
     if cfg.head_pruning.enabled:
-        nh = cfg.head_pruning.num_heads
+        hp = cfg.head_pruning
+        nh = hp.num_heads
         if nh <= 0:
             raise ValueError("head_pruning needs num_heads")
-        for g in cfg.head_pruning.groups:
+        for g in hp.groups:
+            rx = re.compile(g.modules or _DEFAULT_SCOPES["head"])
+            if hp.method == "topk":
+                prunes.append(
+                    (lambda w, s, r=g.dense_ratio:
+                     jnp.repeat(movement_mask(s, r),
+                                w.shape[0] // nh).astype(w.dtype)[:, None],
+                     rx, hp.schedule_offset, "#head"))
+            else:
+                prunes.append(
+                    (lambda w, r=g.dense_ratio:
+                     _head_mask(w, r, nh)[:, None],
+                     rx, hp.schedule_offset, None))
+    cp = cfg.channel_pruning
+    for g in (cp.groups if cp.enabled else ()):
+        rx = re.compile(g.modules or _DEFAULT_SCOPES["channel"])
+        if cp.method == "topk":
             prunes.append(
-                (lambda w, r=g.dense_ratio: _head_mask(w, r, nh)[:, None],
-                 re.compile(g.modules or _DEFAULT_SCOPES["head"]),
-                 cfg.head_pruning.schedule_offset, False))
+                (lambda w, s, r=g.dense_ratio:
+                 movement_mask(s, r).astype(w.dtype)[None, :],
+                 rx, cp.schedule_offset, "#channel"))
+        else:
+            # output-channel L1 over [kh, kw, cin]: _row_mask reduces
+            # every axis but the last, so it IS the channel decision on
+            # 4-D conv kernels (its [1, out] mask broadcasts to HWIO)
+            prunes.append((lambda w, r=g.dense_ratio: _row_mask(w, r),
+                           rx, cp.schedule_offset, None))
 
     def transform(path, leaf):
         name = _path_str(path)
@@ -441,15 +503,17 @@ def compress_params(params, cfg, step):
         # per-LAYER decisions (the reference masks each weight matrix),
         # so vmap the mask over it
         stacked = name.startswith("blocks") and leaf.ndim >= 2
-        for mask_fn, rx, offset, uses_scores in prunes:
+        for mask_fn, rx, offset, suffix in prunes:
             if rx.search(name):
+                uses_scores = suffix is not None
                 if uses_scores:
-                    s = (scores or {}).get(name)
+                    s = (scores or {}).get(name + suffix)
                     if s is None:
                         raise ValueError(
                             f"movement pruning: no trainable scores for "
-                            f"'{name}' — call add_movement_scores(params,"
-                            f" cfg) before training")
+                            f"'{name + suffix}' — call "
+                            f"add_movement_scores(params, cfg) before "
+                            f"training")
                     mask = (jax.vmap(mask_fn)(out, s) if stacked
                             else mask_fn(out, s))
                 else:
@@ -547,19 +611,35 @@ def init_compression(model, compression_config: Dict[str, Any]):
             "rewrites params AND model depth) — call "
             "apply_layer_reduction(model, params, ...) first, then pass "
             "the student here with layer_reduction removed")
-    model = init_compression_model(model, cfg)
+    aq = cfg.activation_quantization
+    model_q = init_compression_model(model, cfg)
+    if aq.enabled and aq.schedule_offset:
+        # schedule_offset (reference act-quant config): full-precision
+        # activations until the offset step, quantized after — both
+        # branches trace once, the step gate selects at runtime
+        base = model
+
+        def model_loss(params, batch, step):
+            return jax.lax.cond(
+                step >= aq.schedule_offset,
+                lambda p: model_q.loss(p, batch),
+                lambda p: base.loss(p, batch), params)
+    else:
+        def model_loss(params, batch, step):
+            del step
+            return model_q.loss(params, batch)
+
     if not cfg.any_param_transform:
-        if not cfg.activation_quantization.enabled:
+        if not aq.enabled:
             logger.warning("init_compression: nothing enabled — loss "
                            "returned unchanged")
 
         def plain_loss(params, batch, step=0):
-            del step
-            return model.loss(params, batch)
+            return model_loss(params, batch, step)
         return plain_loss
 
     def compressed_loss(params, batch, step=0):
-        return model.loss(compress_params(params, cfg, step), batch)
+        return model_loss(compress_params(params, cfg, step), batch, step)
 
     return compressed_loss
 
@@ -661,9 +741,17 @@ class MovementPruningModel:
                 node = (node[int(part)] if isinstance(node, (list, tuple))
                         else node[part])
             return node
+        from jax.sharding import PartitionSpec
         shapes = jax.eval_shape(self.init, jax.random.PRNGKey(0))
-        score_specs = {name: lookup(name)
-                       for name in shapes[MASK_SCORES_KEY]}
+        score_specs = {}
+        for name, shp in shapes[MASK_SCORES_KEY].items():
+            if "#" in name:
+                # row/head/channel scores are REDUCED shapes ([out]/[nh])
+                # — tiny vectors, replicated (the kernel's spec no longer
+                # matches their rank)
+                score_specs[name] = PartitionSpec(*([None] * len(shp.shape)))
+            else:
+                score_specs[name] = lookup(name)
         return {**inner, MASK_SCORES_KEY: score_specs}
 
     def __getattr__(self, name):

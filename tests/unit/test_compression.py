@@ -61,17 +61,22 @@ class TestParsing:
         assert cfg.activation_quantization.bits == 8
         assert cfg.layer_reduction.teacher_layer == (0, 3)
 
-    def test_channel_and_row_topk_reject_loudly(self):
-        with pytest.raises(NotImplementedError, match="channel"):
-            parse_compression_config({
-                "channel_pruning": {"shared_parameters": {
-                    "enabled": True}}})
-        with pytest.raises(NotImplementedError, match="structural"):
-            parse_compression_config({
-                "row_pruning": {"shared_parameters": {
-                    "enabled": True, "method": "topk"},
-                    "different_groups": {"g": {
-                        "params": {"dense_ratio": 0.5}}}}})
+    def test_channel_and_row_topk_parse(self):
+        """r4 VERDICT missing #1: channel pruning and row/head topk are
+        implementations now, not rejects."""
+        cfg = parse_compression_config({
+            "channel_pruning": {"shared_parameters": {
+                "enabled": True},
+                "different_groups": {"g": {
+                    "params": {"dense_ratio": 0.5}}}}})
+        assert cfg.channel_pruning.enabled
+        assert cfg.channel_pruning.groups[0].dense_ratio == 0.5
+        cfg = parse_compression_config({
+            "row_pruning": {"shared_parameters": {
+                "enabled": True, "method": "topk"},
+                "different_groups": {"g": {
+                    "params": {"dense_ratio": 0.5}}}}})
+        assert cfg.row_pruning.method == "topk"
 
     def test_sparse_topk_parses(self):
         cfg = parse_compression_config({
@@ -309,3 +314,111 @@ class TestLayerReduction:
         np.testing.assert_array_equal(
             np.asarray(sp["blocks"]["ln1"]["scale"][1]),
             np.asarray(params["blocks"]["ln1"]["scale"][3]))
+
+
+class TestRound5Parity:
+    """r4 VERDICT missing #1 closures: channel pruning (conv family),
+    row/head topk via movement scores, act-quant schedule_offset."""
+
+    def test_channel_pruning_l1_on_conv_kernels(self):
+        from deepspeed_tpu.compression import compress_params
+        rs = np.random.RandomState(0)
+        params = {"down": {"conv1": {
+            "kernel": jnp.asarray(rs.randn(3, 3, 8, 16), jnp.float32),
+            "bias": jnp.zeros((16,), jnp.float32)}}}
+        cfg = parse_compression_config({
+            "channel_pruning": {"shared_parameters": {"enabled": True},
+                                "different_groups": {"g": {
+                                    "params": {"dense_ratio": 0.25}}}}})
+        out = compress_params(params, cfg, jnp.asarray(0))
+        k = np.asarray(out["down"]["conv1"]["kernel"])
+        # whole OUTPUT channels zeroed: 12 of 16 all-zero
+        zeroed = [i for i in range(16) if (k[..., i] == 0).all()]
+        assert len(zeroed) == 12
+        # survivors untouched
+        keep = [i for i in range(16) if i not in zeroed]
+        ref = np.asarray(params["down"]["conv1"]["kernel"])
+        np.testing.assert_array_equal(k[..., keep], ref[..., keep])
+        # and the kept channels are the L1-largest ones
+        norms = np.abs(ref).sum((0, 1, 2))
+        assert set(keep) == set(np.argsort(norms)[-4:])
+
+    def test_channel_pruning_topk_movement_scores(self):
+        from deepspeed_tpu.compression import (add_movement_scores,
+                                               compress_params)
+        rs = np.random.RandomState(0)
+        params = {"up": {"conv2": {
+            "kernel": jnp.asarray(rs.randn(3, 3, 4, 8), jnp.float32)}}}
+        cc = {"channel_pruning": {"shared_parameters": {
+            "enabled": True, "method": "topk"},
+            "different_groups": {"g": {"params": {"dense_ratio": 0.5}}}}}
+        cfg = parse_compression_config(cc)
+        p = add_movement_scores(params, cfg)
+        assert "up/conv2/kernel#channel" in p["_mask_scores"]
+        assert p["_mask_scores"]["up/conv2/kernel#channel"].shape == (8,)
+        out = compress_params(p, cfg, jnp.asarray(0))
+        k = np.asarray(out["up"]["conv2"]["kernel"])
+        zeroed = [i for i in range(8) if (k[..., i] == 0).all()]
+        assert len(zeroed) == 4
+        # the scores receive the movement gradient (STE through the mask)
+        def loss(pp):
+            o = compress_params(pp, cfg, jnp.asarray(0))
+            return jnp.sum(o["up"]["conv2"]["kernel"] ** 2)
+        g = jax.grad(loss)(p)
+        gs = np.asarray(g["_mask_scores"]["up/conv2/kernel#channel"])
+        assert np.abs(gs).max() > 0
+
+    def test_row_and_head_topk_train(self):
+        """Row + head topk pruning train through the engine like sparse
+        topk does, with per-feature / per-head scores."""
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.compression import MovementPruningModel
+        cc = {"row_pruning": {"shared_parameters": {
+                  "enabled": True, "method": "topk"},
+                  "different_groups": {"g": {
+                      "params": {"dense_ratio": 0.5}}}},
+              "head_pruning": {"shared_parameters": {
+                  "enabled": True, "method": "topk", "num_heads": 4},
+                  "different_groups": {"g": {
+                      "params": {"dense_ratio": 0.5}}}}}
+        wrapped = MovementPruningModel(tiny_model(), cc)
+        engine, _, _, _ = ds.initialize(
+            model=wrapped, config={
+                "train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+                "mesh": {"data": 8}, "steps_per_print": 0})
+        scores = engine.state["params"]["_mask_scores"]
+        assert any(k.endswith("#row") for k in scores)
+        assert any(k.endswith("#head") for k in scores)
+        losses = [float(engine.train_step(batch(8))["loss"])
+                  for _ in range(6)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+        # burn-in keeps the structure: half the fc_in output features zero
+        cleaned = redundancy_clean(engine.state["params"], cc)
+        k = np.asarray(jax.device_get(
+            cleaned["blocks"]["mlp"]["fc_in"]["kernel"]))[0]
+        zero_cols = (k == 0).all(axis=0).mean()
+        assert 0.45 < zero_cols < 0.55
+
+    def test_act_quant_schedule_offset_gates(self):
+        """Before the offset the loss is the FULL-PRECISION loss; after,
+        the act-quantized one (reference act-quant schedule_offset)."""
+        from deepspeed_tpu.compression import init_compression
+        model = tiny_model()
+        params = model.init(jax.random.PRNGKey(0))
+        b = batch(4)
+        cc = {"activation_quantization": {"shared_parameters": {
+            "enabled": True, "schedule_offset": 100},
+            "different_groups": {"g": {"params": {"bits": 4}}}}}
+        loss_fn = init_compression(model, cc)
+        before = float(loss_fn(params, b, step=jnp.asarray(0)))
+        after = float(loss_fn(params, b, step=jnp.asarray(100)))
+        plain = float(model.loss(params, b))
+        q_model = __import__(
+            "deepspeed_tpu.compression.compress", fromlist=["x"]
+        ).init_compression_model(model, parse_compression_config(cc))
+        quant = float(q_model.loss(params, b))
+        assert before == pytest.approx(plain, rel=1e-6)
+        assert after == pytest.approx(quant, rel=1e-6)
+        assert abs(plain - quant) > 1e-6   # 4-bit acts actually differ
