@@ -12,7 +12,8 @@ candidate crash killed the whole tune).
 A job spec is a JSON dict:
   {"cfg": <engine config>, "model_factory": "pkg.mod:callable",
    "model_config": {...}, "steps": 3, "seq": 64,
-   "result_path": "...", "inject_fault": None|"crash"|"hang"}
+   "result_path": "...", "inject_fault": None|"crash"|"hang",
+   "timeout_s": <optional per-spec override of the pool timeout>}
 
 ``inject_fault`` is a chaos hook honoured by the runner (used by the
 fault-isolation tests; the reference has no in-band fault injection —
@@ -77,7 +78,8 @@ class ResourceManager:
             with open(sp, "w") as f:
                 json.dump(spec, f)
             lp = os.path.join(workdir, f"job_{i}.log")
-            pending.append((i, sp, spec["result_path"], lp))
+            budget = float(spec.get("timeout_s", self.timeout_s))
+            pending.append((i, sp, spec["result_path"], lp, budget))
         running: Dict[int, Any] = {}
 
         def tail(log_path: str, n: int = 300) -> str:
@@ -89,12 +91,13 @@ class ResourceManager:
             except OSError:
                 return ""
 
-        def harvest(i, proc, result_path, log_path, timed_out=False):
+        def harvest(i, proc, result_path, log_path, timed_out=False,
+                    budget=None):
             if timed_out:
                 proc.kill()
                 proc.wait()
                 results[i] = {"status": "timeout", "samples_per_sec": None,
-                              "detail": (f"killed after {self.timeout_s}s; "
+                              "detail": (f"killed after {budget}s; "
                                          f"{tail(log_path)}")}
                 return
             proc.wait()
@@ -109,19 +112,19 @@ class ResourceManager:
 
         while pending or running:
             while pending and len(running) < self.slots:
-                i, sp, rp, lp = pending.popleft()
+                i, sp, rp, lp, budget = pending.popleft()
                 proc = self._launch(sp, lp)
-                running[i] = (proc, rp, lp, time.monotonic())
+                running[i] = (proc, rp, lp, time.monotonic(), budget)
                 logger.info(f"autotune scheduler: job {i} launched "
                             f"(pid {proc.pid}, "
                             f"{len(running)}/{self.slots} slots)")
             done = []
-            for i, (proc, rp, lp, t0) in running.items():
+            for i, (proc, rp, lp, t0, budget) in running.items():
                 if proc.poll() is not None:
                     harvest(i, proc, rp, lp)
                     done.append(i)
-                elif time.monotonic() - t0 > self.timeout_s:
-                    harvest(i, proc, rp, lp, timed_out=True)
+                elif time.monotonic() - t0 > budget:
+                    harvest(i, proc, rp, lp, timed_out=True, budget=budget)
                     done.append(i)
             for i in done:
                 running.pop(i)

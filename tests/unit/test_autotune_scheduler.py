@@ -37,8 +37,12 @@ class TestResourceManager:
                        hbm_bytes=1 << 40)
         ok = at._make_specs(seq=16, steps=1)[0]
         crash = dict(ok, inject_fault="crash")
-        hang = dict(ok, inject_fault="hang")
-        rm = ResourceManager(slots=3, timeout_s=25.0, env=CPU_ENV)
+        # Only the hang spec gets a short budget: the ok job's wall time
+        # is jax-import + compile and varies a lot under full-suite load
+        # (the advisor's r4 note about suite-run flakiness); its budget
+        # must be generous, so the timeout under test is per-spec.
+        hang = dict(ok, inject_fault="hang", timeout_s=25.0)
+        rm = ResourceManager(slots=3, timeout_s=240.0, env=CPU_ENV)
         results = rm.run([ok, crash, hang], str(tmp_path))
         statuses = [r["status"] for r in results]
         assert statuses[0] == "ok" and results[0]["samples_per_sec"] > 0
