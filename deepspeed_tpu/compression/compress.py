@@ -408,8 +408,10 @@ _DEFAULT_SCOPES = {
     "row": r"mlp/fc_in/kernel$",
     "head": r"attn/out/kernel$",
     # the conv family's kernels (models/diffusion.py: conv1/conv2/
-    # conv_shortcut/proj_in/proj_out, all HWIO)
-    "channel": r"(conv[^/]*|proj_in|proj_out)/kernel$",
+    # conv_shortcut and the spatial transformer's 1x1 proj_in/proj_out,
+    # all HWIO). The lookbehind excludes ff/proj_in|proj_out — those are
+    # the DENSE GEGLU feedforward kernels, not convs.
+    "channel": r"(conv[^/]*|(?<!ff/)proj_in|(?<!ff/)proj_out)/kernel$",
 }
 
 
@@ -698,11 +700,13 @@ def calibrate_activation_ranges(model, params, batches) -> tuple:
     for batch in batches:
         ids = jnp.asarray(np.asarray(batch["input_ids"]))
         x = calib_model._embed_tokens(params, ids)
+        wins = calib_model._layer_windows()
         for i in range(c.scan_length):
             lp = jax.tree_util.tree_map(lambda l, i=i: l[i],
                                         params["blocks"])
-            x, _, _ = calib_model._superblock(lp, x, None, None, None,
-                                              False)
+            x, _, _ = calib_model._superblock(
+                lp, x, None, None, None, False,
+                wins[i] if wins is not None else None)
     calib = calib_model._act_calib
     del calib_model._act_calib
     return tuple(calib.get(site, 0.0)

@@ -52,7 +52,11 @@ class InferenceEngine:
         # deliberate MODEL choices whose semantics (layouts, sequence
         # sharding) must survive serving.
         if hasattr(getattr(model, "config", None), "attn_impl") and \
-                model.config.attn_impl in ("xla", "flash"):
+                model.config.attn_impl in ("xla", "flash") and \
+                not getattr(model.config, "attention_layers", ()) and \
+                not getattr(model.config, "attn_softmax_scale", 0.0):
+            # per-layer windows / non-standard softmax scale (GPT-Neo) pin
+            # the model to the xla path — the Pallas kernels take neither
             import dataclasses as _dc
             want = "flash" if self.config.replace_with_kernel_inject else "xla"
             if model.config.attn_impl != want:

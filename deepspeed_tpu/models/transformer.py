@@ -95,6 +95,18 @@ class TransformerConfig:
     # params seam (see compression.calibrate_activation_ranges).
     act_quant_ranges: tuple = ()
     layernorm_eps: float = 1e-5
+    # Softmax logit scale: 0.0 → the usual 1/sqrt(head_dim); GPT-Neo
+    # famously trains UNSCALED (reference policy `containers/gptneo.py:75`
+    # passes scale_attention=False) — its HF import sets 1.0.
+    attn_softmax_scale: float = 0.0
+    # Per-layer attention pattern (the GPT-Neo family, reference
+    # `containers/gptneo.py`): tuple of "global"/"local" per layer; local
+    # layers see a trailing window of ``local_attention_window`` keys
+    # (current token + W-1 predecessors). The pattern rides the layer scan
+    # as a per-layer window operand, so the block still compiles ONCE —
+    # heterogeneity is data, not code. Empty = all-global (default).
+    attention_layers: tuple = ()
+    local_attention_window: int = 256
     # Chunked cross-entropy: the [B,T,V] logits tensor is the largest HBM
     # object at vocab 50k; computing the loss in sequence chunks of this many
     # tokens (0 = off) keeps only [B,chunk,V] live, rematerializing per chunk
@@ -230,6 +242,28 @@ class TransformerLM:
         # to the standard block tree.
         self.block_transform = block_transform or (lambda sp: sp)
         self.mesh = None          # bound by the engine (ring attention)
+        if config.attention_layers:
+            if len(config.attention_layers) != config.num_layers:
+                raise ValueError(
+                    f"attention_layers has {len(config.attention_layers)} "
+                    f"entries for {config.num_layers} layers")
+            bad = set(config.attention_layers) - {"global", "local"}
+            if bad:
+                raise ValueError(f"attention_layers entries must be "
+                                 f"'global'/'local', got {sorted(bad)}")
+            if config.moe_enabled:
+                raise NotImplementedError(
+                    "attention_layers (per-layer local windows) is not "
+                    "plumbed through the MoE superblock scan")
+            if config.attn_impl != "xla":
+                raise NotImplementedError(
+                    f"attention_layers needs attn_impl='xla' (the Pallas "
+                    f"kernels take no per-layer window operand); got "
+                    f"{config.attn_impl!r}")
+        if config.attn_softmax_scale and config.attn_impl != "xla":
+            raise NotImplementedError(
+                "attn_softmax_scale != 1/sqrt(hd) needs attn_impl='xla' "
+                "(the Pallas kernels bake in the standard scale)")
         if config.pos_embedding == "rotary":
             self._cos, self._sin = L.rotary_freqs(
                 config.hdim, config.rotary_dim, config.max_seq_len,
@@ -439,8 +473,28 @@ class TransformerLM:
         from ..ops.quantizer.quantizer import fake_quantize
         return fake_quantize(x, c.act_quant_bits, 1, c.act_quant_symmetric)
 
+    # global layers ride the same per-layer-window scan operand as local
+    # ones; qpos-kpos never exceeds max_seq_len, so this sentinel means
+    # "no window" without risking i32 overflow in the mask arithmetic
+    _GLOBAL_WINDOW = 1 << 30
+
+    def _layer_windows(self) -> Optional[jnp.ndarray]:
+        """[num_layers] i32 per-layer attention window, or None when the
+        config has no per-layer pattern."""
+        c = self.config
+        if not c.attention_layers:
+            return None
+        return jnp.asarray(
+            [c.local_attention_window if a == "local"
+             else self._GLOBAL_WINDOW for a in c.attention_layers],
+            jnp.int32)
+
+    @property
+    def _attn_scale(self) -> Optional[float]:
+        return self.config.attn_softmax_scale or None
+
     # -- block -------------------------------------------------------------
-    def _attention(self, p, x, cache_kv=None, positions=None):
+    def _attention(self, p, x, cache_kv=None, positions=None, window=None):
         c = self.config
         nh, hd = c.num_heads, c.hdim
         nkv = c.kv_heads
@@ -552,8 +606,17 @@ class TransformerLM:
                 # (block-row gathered at the query positions) — dense
                 # fallback would let every token see full history
                 sparse_mask = self._sparse_decode_mask(idx, t, tk)
+            band = None
+            if window is not None:
+                # honor explicit positions (left-padded batched decode) the
+                # same way the ALiBi bias above does
+                qpos = (positions[0] if positions is not None
+                        else idx + jnp.arange(t))
+                band = (qpos[:, None] - jnp.arange(tk)[None, :]) < window
             if nkv != nh:
                 valid = jnp.arange(tk)[None, None, None, None, :] < (idx + t)
+                if band is not None:
+                    valid = valid & band[None, None, None]
                 if sparse_mask is not None:
                     sm = (sparse_mask[:, :, None]      # [1,1,1,t,tk]
                           if sparse_mask.shape[1] == 1
@@ -562,23 +625,36 @@ class TransformerLM:
                     valid = valid & sm
                 o = L.gqa_attention(q, ck.astype(q.dtype),
                                     cv.astype(q.dtype), mask=valid,
-                                    kv_positions_offset=offset, bias=bias)
+                                    kv_positions_offset=offset, bias=bias,
+                                    scale=self._attn_scale)
             else:
                 valid = jnp.arange(tk)[None, None, None, :] < (idx + t)
+                if band is not None:
+                    valid = valid & band[None, None]
                 if sparse_mask is not None:
                     valid = valid & sparse_mask
                 o = L.causal_attention(q, ck.astype(q.dtype),
                                        cv.astype(q.dtype), mask=valid,
                                        kv_positions_offset=offset,
-                                       bias=bias)
+                                       bias=bias, scale=self._attn_scale)
         else:
             bias = None
             if c.pos_embedding == "alibi":
                 bias = L.alibi_bias(nh, t, jnp.arange(t))[None]
+            band = None
+            if window is not None:
+                pos = jnp.arange(t)
+                band = (pos[:, None] - pos[None, :]) < window
             if nkv != nh:
-                o = L.gqa_attention(q, k, v, causal=c.causal, bias=bias)
+                o = L.gqa_attention(
+                    q, k, v, causal=c.causal, bias=bias,
+                    mask=None if band is None else band[None, None, None],
+                    scale=self._attn_scale)
             else:
-                o = L.causal_attention(q, k, v, causal=c.causal, bias=bias)
+                o = L.causal_attention(
+                    q, k, v, causal=c.causal, bias=bias,
+                    mask=None if band is None else band[None, None],
+                    scale=self._attn_scale)
         o = o.reshape(b, t, nh * hd)
         return L.dense_apply(p["out"], o), new_cache
 
@@ -593,24 +669,24 @@ class TransformerLM:
         h = L.ACT_FNS[self.config.activation](h)
         return L.dense_apply(p["fc_out"], h)
 
-    def _block(self, bp, x, cache_kv=None, positions=None):
+    def _block(self, bp, x, cache_kv=None, positions=None, window=None):
         c = self.config
         norm = self._norm_fn()
         x = self.constrain(x)
         if c.norm_position == "post":
             # BERT family: ln(x + f(x)); ln1 after attention, ln2 after FFN
             a, new_cache = self._attention(bp["attn"], x, cache_kv,
-                                           positions)
+                                           positions, window)
             x = norm(bp["ln1"], x + a)
             x = norm(bp["ln2"], x + self._mlp(bp["mlp"], x))
         elif c.parallel_residual:
             a, new_cache = self._attention(bp["attn"], norm(bp["ln1"], x),
-                                           cache_kv, positions)
+                                           cache_kv, positions, window)
             m = self._mlp(bp["mlp"], norm(bp["ln2"], x))
             x = x + a + m
         else:
             a, new_cache = self._attention(bp["attn"], norm(bp["ln1"], x),
-                                           cache_kv, positions)
+                                           cache_kv, positions, window)
             x = x + a
             x = x + self._mlp(bp["mlp"], norm(bp["ln2"], x))
         return self.constrain(x), new_cache
@@ -635,7 +711,7 @@ class TransformerLM:
         return self.constrain(x), new_cache, laux
 
     def _superblock(self, sp, x, caches=None, positions=None, rng=None,
-                    train=True):
+                    train=True, window=None):
         """One scanned unit: a dense block (moe_freq=2 only) followed by a
         MoE block, or just a dense block when MoE is off.
 
@@ -644,7 +720,7 @@ class TransformerLM:
         c = self.config
         if not c.moe_enabled:
             y, nc = self._block(sp, x, caches[0] if caches else None,
-                                positions)
+                                positions, window)
             return y, ((nc,) if caches else None), jnp.zeros((), jnp.float32)
         new_caches = []
         if c.moe_freq == 2:
@@ -724,14 +800,23 @@ class TransformerLM:
                 nk = jnp.stack([nc[0] for nc in ncs])
                 nv = jnp.stack([nc[1] for nc in ncs])
                 return y, (nk, nv)
+        elif c.attention_layers:
+            def scan_fn(carry, xs):
+                bp, ck, cv, win = xs
+                bp = self.block_transform(bp)
+                y, kv = self._block(bp, carry, (ck, cv, idx), positions,
+                                    window=win)
+                return y, kv
         else:
             def scan_fn(carry, xs):
                 bp, ck, cv = xs
                 bp = self.block_transform(bp)
                 y, kv = self._block(bp, carry, (ck, cv, idx), positions)
                 return y, kv
-        x, (nk, nv) = jax.lax.scan(scan_fn, x,
-                                   (params["blocks"], cache["k"], cache["v"]))
+        xs = (params["blocks"], cache["k"], cache["v"])
+        if not c.moe_enabled and c.attention_layers:
+            xs = xs + (self._layer_windows(),)
+        x, (nk, nv) = jax.lax.scan(scan_fn, x, xs)
         new_cache = {"k": nk, "v": nv, "index": idx + input_ids.shape[1]}
         if c.final_layernorm:
             x = self._norm_fn()(params["ln_f"], x)
@@ -783,14 +868,15 @@ class TransformerLM:
         x = self._embed_tokens(params, input_ids,
                                token_type_ids=token_type_ids)
 
-        def sb_fn(sp, x, key):
+        def sb_fn(sp, x, key, window=None):
             if c.remat == "host_offload":
                 # name the per-layer residual stream so the offload remat
                 # policy can spill it to host DRAM between fwd and bwd
                 from jax.ad_checkpoint import checkpoint_name
                 x = checkpoint_name(x, "block_in")
             sp = self.block_transform(sp)
-            y, _, la = self._superblock(sp, x, None, None, key, train)
+            y, _, la = self._superblock(sp, x, None, None, key, train,
+                                        window)
             return y, la
         sb = self._remat(sb_fn)
         zero = jnp.zeros((), jnp.float32)
@@ -804,6 +890,15 @@ class TransformerLM:
                 return (y, carry[1] + la), None
             (x, laux), _ = jax.lax.scan(scan_fn, (x, zero),
                                         (params["blocks"], keys))
+        elif c.attention_layers:
+            # per-layer window rides the scan so the block compiles once
+            def scan_fn(carry, xs):
+                sp, win = xs
+                y, la = sb(sp, carry[0], None, win)
+                return (y, carry[1] + la), None
+            (x, laux), _ = jax.lax.scan(
+                scan_fn, (x, zero),
+                (params["blocks"], self._layer_windows()))
         else:
             def scan_fn(carry, sp):
                 y, la = sb(sp, carry[0], None)

@@ -672,6 +672,85 @@ def load_hf_distilbert(state_dict: Dict[str, Any],
     return params
 
 
+def hf_gptneo_config(hf_cfg, **overrides) -> TransformerConfig:
+    """transformers.GPTNeoConfig → TransformerConfig (reference container
+    `module_inject/containers/gptneo.py:73`).
+
+    Two architecture oddities the config carries: UNSCALED softmax logits
+    (the reference policy passes scale_attention=False, `gptneo.py:75`) and
+    the alternating global/local attention pattern — per-layer windows ride
+    the layer scan (TransformerConfig.attention_layers), closing the r2-r4
+    documented reject."""
+    return TransformerConfig(
+        vocab_size=hf_cfg.vocab_size,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        num_layers=hf_cfg.num_layers,
+        num_heads=hf_cfg.num_heads,
+        d_model=hf_cfg.hidden_size,
+        d_ff=hf_cfg.intermediate_size or 4 * hf_cfg.hidden_size,
+        pos_embedding="learned",
+        parallel_residual=False,
+        norm_type="layernorm",
+        activation=_map_act(hf_cfg.activation_function),
+        use_bias=True,
+        tie_embeddings=True,
+        layernorm_eps=hf_cfg.layer_norm_epsilon,
+        attn_softmax_scale=1.0,
+        attention_layers=tuple(hf_cfg.attention_layers),
+        local_attention_window=hf_cfg.window_size,
+        attn_impl="xla",
+        **overrides)
+
+
+def load_hf_gptneo(state_dict: Dict[str, Any],
+                   config: TransformerConfig) -> Dict:
+    """HF GPT-Neo state dict → params pytree.
+
+    Unlike GPT-2's Conv1D, every projection is nn.Linear ([out, in] →
+    transpose); q/k/v are separate and BIAS-FREE (out_proj keeps its bias),
+    so the fused qkv bias is zero-filled — same concat order the reference's
+    maybe_copy_qkv uses (`containers/gptneo.py:40`)."""
+    sd = {k.replace("transformer.", ""): v for k, v in state_dict.items()}
+    n = config.num_layers
+    d = config.d_model
+
+    def blk_t(name):
+        return _stack(sd, "h.{i}." + name, n).transpose(0, 2, 1)
+
+    def blk_b(name):
+        return _stack(sd, "h.{i}." + name, n)
+
+    qkv_kernel = np.concatenate(
+        [blk_t("attn.attention.q_proj.weight"),
+         blk_t("attn.attention.k_proj.weight"),
+         blk_t("attn.attention.v_proj.weight")], axis=2)
+    params = {
+        "embed": {"embedding": _np(sd["wte.weight"])},
+        "pos_embed": {"embedding": _np(sd["wpe.weight"])},
+        "blocks": {
+            "ln1": {"scale": blk_b("ln_1.weight"),
+                    "bias": blk_b("ln_1.bias")},
+            "attn": {
+                "qkv": {"kernel": qkv_kernel,
+                        "bias": np.zeros((n, 3 * d), np.float32)},
+                "out": {"kernel": blk_t("attn.attention.out_proj.weight"),
+                        "bias": blk_b("attn.attention.out_proj.bias")},
+            },
+            "ln2": {"scale": blk_b("ln_2.weight"),
+                    "bias": blk_b("ln_2.bias")},
+            "mlp": {
+                "fc_in": {"kernel": blk_t("mlp.c_fc.weight"),
+                          "bias": blk_b("mlp.c_fc.bias")},
+                "fc_out": {"kernel": blk_t("mlp.c_proj.weight"),
+                           "bias": blk_b("mlp.c_proj.bias")},
+            },
+        },
+        "ln_f": {"scale": _np(sd["ln_f.weight"]),
+                 "bias": _np(sd["ln_f.bias"])},
+    }
+    return params
+
+
 # registry (reference replace_policy.py:17)
 POLICIES = {
     "gpt2": (hf_gpt2_config, load_hf_gpt2),
@@ -682,12 +761,8 @@ POLICIES = {
     "llama": (hf_llama_config, load_hf_llama),
     "gptj": (hf_gptj_config, load_hf_gptj),
     "distilbert": (hf_distilbert_config, load_hf_distilbert),
+    "gpt_neo": (hf_gptneo_config, load_hf_gptneo),
 }
-# gpt_neo is deliberately ABSENT: its alternating global/local attention
-# (window 256) cannot be expressed by this framework's uniform scanned
-# block without a heterogeneous superblock (the dense+moe superblock
-# pattern generalized to per-sub-block attention masks) — rejected via
-# the registry error rather than shipping wrong long-context math.
 
 
 def convert_hf_model(hf_model, **config_overrides):

@@ -213,6 +213,17 @@ class PipelineEngine(DeepSpeedEngine):
     def micro_batches(self) -> int:
         return self.gradient_accumulation_steps
 
+    def _stage_windows(self, model, sid):
+        """This stage's slice of the per-layer attention-window vector
+        (TransformerConfig.attention_layers — the GPT-Neo family), or None
+        when the model has none. ``sid`` is the traced stage index, so the
+        slice is dynamic while its length (layers per stage) is static."""
+        wins = getattr(model, "_layer_windows", lambda: None)()
+        if wins is None:
+            return None
+        lps = model.config.scan_length // self.num_stages
+        return jax.lax.dynamic_slice(wins, (sid * lps,), (lps,))
+
     # -- the pipeline loss program (runs inside shard_map over 'pipe') -----
     def _pipeline_loss(self, params, ids):
         """ids: [M, mb, T] (replicated over pipe; 'data' handled by GSPMD).
@@ -248,17 +259,24 @@ class PipelineEngine(DeepSpeedEngine):
                               partial(norm, eps=cfg.layernorm_eps),
                               params["ln_f"], y, tok, chunk, onehot)
 
-        def sb_fn(sp, x):
-            y, _, la = model._superblock(sp, x)
+        def sb_fn(sp, x, win=None):
+            y, _, la = model._superblock(sp, x, None, None, None, True, win)
             return y, la
         sb = model._remat(sb_fn)
+        # per-layer attention windows (GPT-Neo family): this stage's slice
+        # of the window vector rides the stage scan like the params do;
+        # None (the common case) keeps the scan structure window-free
+        win_local = self._stage_windows(model, sid)
+        xs_local = (blocks_local if win_local is None
+                    else (blocks_local, win_local))
 
         def stage_fn(x):
-            def f(c, sp):
-                y, la = sb(sp, c[0])
+            def f(c, xs):
+                sp, win = (xs, None) if win_local is None else xs
+                y, la = sb(sp, c[0], win)
                 return (y, c[1] + la), None
             (y, laux), _ = jax.lax.scan(
-                f, (x, jnp.zeros((), jnp.float32)), blocks_local)
+                f, (x, jnp.zeros((), jnp.float32)), xs_local)
             return y, laux
 
         perm = [(i, (i + 1) % s) for i in range(s)]
@@ -348,11 +366,15 @@ class PipelineEngine(DeepSpeedEngine):
                 x = x + L.embedding_apply(ep["pos_embed"], pos, cfg.dtype)
             return x
 
+        win_local = self._stage_windows(model, sid)
+
         def stage_fn(bl, x):
-            def f(c, bp):
-                y, _ = model._block(bp, c)
+            def f(c, xs):
+                bp, win = (xs, None) if win_local is None else xs
+                y, _ = model._block(bp, c, None, None, win)
                 return y, None
-            y, _ = jax.lax.scan(f, x, bl)
+            y, _ = jax.lax.scan(
+                f, x, bl if win_local is None else (bl, win_local))
             return y
 
         chunk = cfg.loss_chunk if (cfg.loss_chunk and

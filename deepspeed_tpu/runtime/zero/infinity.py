@@ -267,6 +267,12 @@ class InfinityStepper:
     # ------------------------------------------------------------------
     @staticmethod
     def _validate(engine, model, cfg) -> None:
+        if getattr(getattr(model, "config", None), "attention_layers", ()):
+            raise NotImplementedError(
+                "ZeRO-Infinity streams layers through a layer-index-free "
+                "block_fwd, which cannot carry the per-layer attention "
+                "windows of attention_layers (GPT-Neo family); train this "
+                "model with the in-HBM engine, or drop attention_layers")
         for attr in ("init_superblock", "init_resident", "_superblock"):
             if not hasattr(model, attr):
                 raise NotImplementedError(

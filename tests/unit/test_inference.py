@@ -223,16 +223,56 @@ class TestHFPolicies:
         got = np.asarray(model.apply(params, jnp.asarray(ids)))
         np.testing.assert_allclose(got, want, atol=2e-3)
 
-    def test_gpt_neo_rejected_with_reason(self):
-        """GPT-Neo's alternating global/local attention cannot map onto
-        the uniform scanned block — the registry rejects it loudly."""
+    def test_gpt_neo_logit_parity(self):
+        """GPT-Neo (r5): alternating global/local attention as per-layer
+        windows riding the layer scan, UNSCALED softmax logits, bias-free
+        q/k/v. window_size=4 << seq so a wrong/missing window moves the
+        logits (the r2-r4 documented reject, closed)."""
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        hf_cfg = transformers.GPTNeoConfig(
+            vocab_size=96, max_position_embeddings=32, hidden_size=48,
+            num_layers=4, num_heads=4, window_size=4,
+            attention_types=[[["global", "local"], 2]],
+            resid_dropout=0.0, embed_dropout=0.0, attention_dropout=0.0)
+        hf = transformers.GPTNeoForCausalLM(hf_cfg).eval()
         from deepspeed_tpu.module_inject import convert_hf_model
+        cfg, params = convert_hf_model(hf, dtype=jnp.float32, loss_chunk=0)
+        assert cfg.attention_layers == ("global", "local") * 2
+        assert cfg.attn_softmax_scale == 1.0
+        model = TransformerLM(cfg)
+        ids = np.random.RandomState(0).randint(0, 96, (2, 16))
+        with torch.no_grad():
+            want = hf(torch.tensor(ids)).logits.numpy()
+        got = np.asarray(model.apply(params, jnp.asarray(ids)))
+        np.testing.assert_allclose(got, want, atol=2e-3)
 
-        class FakeNeo:
-            class config:
-                model_type = "gpt_neo"
-        with pytest.raises(ValueError, match="gpt_neo"):
-            convert_hf_model(FakeNeo())
+    def test_gpt_neo_cached_decode_matches_full_forward(self):
+        """The decode path must apply the SAME per-layer windows as the
+        full forward — prefill + token-at-a-time logits vs one-shot."""
+        pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        hf_cfg = transformers.GPTNeoConfig(
+            vocab_size=96, max_position_embeddings=32, hidden_size=48,
+            num_layers=4, num_heads=4, window_size=4,
+            attention_types=[[["global", "local"], 2]],
+            resid_dropout=0.0, embed_dropout=0.0, attention_dropout=0.0)
+        hf = transformers.GPTNeoForCausalLM(hf_cfg).eval()
+        from deepspeed_tpu.module_inject import convert_hf_model
+        cfg, params = convert_hf_model(hf, dtype=jnp.float32, loss_chunk=0)
+        model = TransformerLM(cfg)
+        ids = np.random.RandomState(1).randint(0, 96, (2, 12))
+        full = np.asarray(model.apply(params, jnp.asarray(ids)))
+        cache = model.init_cache(2, 16, dtype=jnp.float32)
+        lg, cache = model.apply(params, jnp.asarray(ids[:, :8]),
+                                cache=cache)
+        step = [np.asarray(lg)[:, -1]]
+        for t in range(8, 12):
+            lg, cache = model.apply(params, jnp.asarray(ids[:, t:t + 1]),
+                                    cache=cache)
+            step.append(np.asarray(lg)[:, -1])
+        got = np.stack(step, axis=1)               # logits at pos 7..11
+        np.testing.assert_allclose(got, full[:, 7:], atol=2e-3)
 
     def test_opt_logit_parity(self):
         torch = pytest.importorskip("torch")

@@ -17,9 +17,9 @@ from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
                                                partition_layers)
 
 
-def tiny_model(layers=4):
+def tiny_model(layers=4, **kw):
     cfg = gpt2_config("125m", num_layers=layers, d_model=32, num_heads=4,
-                      vocab_size=64, max_seq_len=16, dtype=jnp.float32)
+                      vocab_size=64, max_seq_len=16, dtype=jnp.float32, **kw)
     return TransformerLM(cfg)
 
 
@@ -155,6 +155,30 @@ class TestPipelineEngine:
     def test_pp2_matches_dp(self):
         ref = self._dp_reference_losses()
         _, pp = self._pp_losses({"pipe": 2, "data": 4})
+        np.testing.assert_allclose(ref, pp, rtol=2e-4)
+
+    @pytest.mark.parametrize("sched", ["1f1b", "gpipe"])
+    def test_pp2_attention_layers_matches_dp(self, sched):
+        """GPT-Neo-style per-layer local windows must survive the pipeline
+        stage split: each stage applies ITS slice of the window vector.
+        window=4 << seq=16 so an all-global stage moves the loss."""
+        neo = dict(attention_layers=("global", "local") * 2,
+                   local_attention_window=4, attn_impl="xla")
+        engine, _, _, _ = ds.initialize(
+            model=tiny_model(4, **neo), config=base_config(mesh={"data": 8}),
+            rng=jax.random.PRNGKey(3))
+        ref = [float(engine.train_step(
+            fixed_batch(engine.train_batch_size, seed=i))["loss"])
+            for i in range(3)]
+        mesh_conf = {"pipe": 2, "data": 4}
+        mesh = build_mesh(MeshConfig(**mesh_conf))
+        cfgd = base_config(pipeline={"schedule": sched})
+        cfgd["mesh"] = mesh_conf
+        peng = PipelineEngine(model=tiny_model(4, **neo), config=cfgd,
+                              mesh=mesh, rng=jax.random.PRNGKey(3))
+        pp = [float(peng.train_step(
+            fixed_batch(peng.train_batch_size, seed=i))["loss"])
+            for i in range(3)]
         np.testing.assert_allclose(ref, pp, rtol=2e-4)
 
     def test_pp4_matches_dp(self):
