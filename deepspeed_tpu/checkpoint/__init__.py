@@ -1,6 +1,11 @@
 """Checkpoint tools — counterpart of `/root/reference/deepspeed/checkpoint/`."""
+from .megatron import (load_megatron_checkpoint, megatron_gpt_config,
+                       megatron_to_params, merge_megatron_state_dicts,
+                       split_megatron_state_dict)
 from .universal import (export_universal, import_universal, load_universal,
                         unflatten)
 
 __all__ = ["export_universal", "import_universal", "load_universal",
-           "unflatten"]
+           "unflatten", "load_megatron_checkpoint", "megatron_gpt_config",
+           "megatron_to_params", "merge_megatron_state_dicts",
+           "split_megatron_state_dict"]

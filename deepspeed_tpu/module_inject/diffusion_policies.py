@@ -23,10 +23,13 @@ import jax.numpy as jnp
 import numpy as np
 
 
+from ..utils.interop import to_numpy
+
+
 def _np(v) -> np.ndarray:
-    if hasattr(v, "detach"):
-        v = v.detach().cpu().numpy()
-    return np.asarray(v)
+    # dtype=None: diffusion checkpoints keep their source dtype (the
+    # pipeline casts at device_put)
+    return to_numpy(v, dtype=None)
 
 
 class _SD:

@@ -16,13 +16,7 @@ from typing import Any, Dict
 import numpy as np
 
 from ..models.transformer import TransformerConfig
-
-
-def _np(t) -> np.ndarray:
-    """torch tensor / array-like → numpy (host)."""
-    if hasattr(t, "detach"):
-        t = t.detach().cpu().float().numpy()
-    return np.asarray(t, dtype=np.float32)
+from ..utils.interop import to_numpy as _np
 
 
 def _stack(sd: Dict[str, Any], fmt: str, n: int, **kw) -> np.ndarray:

@@ -1,0 +1,16 @@
+"""Host-side tensor interop shared by every checkpoint/policy loader."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def to_numpy(t, dtype=np.float32) -> np.ndarray:
+    """torch tensor / array-like → host numpy. ``dtype=None`` preserves the
+    source dtype (integer buffers like position ids); the default f32 cast
+    also round-trips torch bf16 (which numpy cannot represent directly)."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu()
+        if dtype is not None:
+            t = t.float()
+        t = t.numpy()
+    return np.asarray(t) if dtype is None else np.asarray(t, dtype=dtype)

@@ -1,0 +1,173 @@
+"""Megatron checkpoint ingestion (8-device CPU mesh).
+
+Reference coverage model: `/root/reference/tests/unit/test_checkpoint.py`
+(mp merge/split round trips) + `inference/test_checkpoint_sharding.py`
+(load at a different mp size). The golden anchor is an HF GPT-2 torch
+model: the test builds Megatron-format shards FROM its weights with
+naive per-head indexing loops (independent math from the loader's
+vectorized reshapes), loads them through the package surface, and
+demands logit parity with the torch forward."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.checkpoint import (load_megatron_checkpoint,
+                                      merge_megatron_state_dicts,
+                                      split_megatron_state_dict)
+from deepspeed_tpu.models import TransformerLM
+
+H, NH, L, V, T = 48, 4, 3, 96, 32
+HN = H // NH
+
+
+def _hf_gpt2():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    cfg = transformers.GPT2Config(
+        vocab_size=V, n_positions=T, n_embd=H, n_layer=L, n_head=NH,
+        activation_function="gelu_new", resid_pdrop=0.0, embd_pdrop=0.0,
+        attn_pdrop=0.0)
+    return transformers.GPT2LMHeadModel(cfg).eval()
+
+
+def _version_layout(canon_rows, version, heads):
+    """Canonical [q|k|v] qkv rows → a Megatron version layout, by naive
+    per-head loops (the independent construction the loader is checked
+    against). Reference layouts: `state_dict_factory.py:247`."""
+    q, k, v = np.split(canon_rows, 3)
+    hn = canon_rows.shape[0] // 3 // heads
+    if version == 0:
+        return canon_rows                       # [3, heads, hn] per shard
+    rows = []
+    if version == 2.0:                          # [heads, 3, hn]
+        for h in range(heads):
+            rows += [q[h * hn:(h + 1) * hn], k[h * hn:(h + 1) * hn],
+                     v[h * hn:(h + 1) * hn]]
+        return np.concatenate(rows, axis=0)
+    if version == 1.0:                          # [heads, hn, 3]
+        for h in range(heads):
+            for d in range(hn):
+                rows.append(np.stack([q[h * hn + d], k[h * hn + d],
+                                      v[h * hn + d]]))
+        return np.concatenate(rows, axis=0)
+    raise AssertionError(version)
+
+
+def _megatron_shards_from_hf(hf, mp, version):
+    """HF GPT-2 weights → ``mp`` Megatron-format shard dicts, built with
+    per-head slicing only (no loader code)."""
+    sd = {k: v.detach().numpy().astype(np.float32)
+          for k, v in hf.state_dict().items()}
+    hpr = NH // mp                               # heads per rank
+    shards = []
+    for r in range(mp):
+        cl = {}
+        cl["word_embeddings.weight"] = np.split(
+            sd["transformer.wte.weight"], mp, axis=0)[r]
+        cl["position_embeddings.weight"] = sd["transformer.wpe.weight"]
+        for i in range(L):
+            p = f"transformer.h.{i}."
+            o = f"transformer.layers.{i}."
+            cl[o + "input_layernorm.weight"] = sd[p + "ln_1.weight"]
+            cl[o + "input_layernorm.bias"] = sd[p + "ln_1.bias"]
+            # HF Conv1D c_attn: [in, 3H] with q|k|v on out → torch-layout
+            # rows [3H, in]; this rank's heads, naive slicing
+            qkv_rows = sd[p + "attn.c_attn.weight"].T
+            qkv_bias = sd[p + "attn.c_attn.bias"]
+            mine_w, mine_b = [], []
+            for blk in range(3):                 # q, k, v
+                base = blk * H + r * hpr * HN
+                mine_w.append(qkv_rows[base:base + hpr * HN])
+                mine_b.append(qkv_bias[base:base + hpr * HN])
+            cl[o + "attention.query_key_value.weight"] = _version_layout(
+                np.concatenate(mine_w, axis=0), version, hpr)
+            cl[o + "attention.query_key_value.bias"] = _version_layout(
+                np.concatenate(mine_b, axis=0), version, hpr)
+            # row-parallel: out-proj [H, H] torch layout [out, in]; this
+            # rank owns in-columns of its heads
+            cl[o + "attention.dense.weight"] = \
+                sd[p + "attn.c_proj.weight"].T[:, r * hpr * HN:
+                                               (r + 1) * hpr * HN]
+            cl[o + "attention.dense.bias"] = sd[p + "attn.c_proj.bias"]
+            cl[o + "post_attention_layernorm.weight"] = sd[p + "ln_2.weight"]
+            cl[o + "post_attention_layernorm.bias"] = sd[p + "ln_2.bias"]
+            cl[o + "mlp.dense_h_to_4h.weight"] = np.split(
+                sd[p + "mlp.c_fc.weight"].T, mp, axis=0)[r]
+            cl[o + "mlp.dense_h_to_4h.bias"] = np.split(
+                sd[p + "mlp.c_fc.bias"], mp, axis=0)[r]
+            cl[o + "mlp.dense_4h_to_h.weight"] = np.split(
+                sd[p + "mlp.c_proj.weight"].T, mp, axis=1)[r]
+            cl[o + "mlp.dense_4h_to_h.bias"] = sd[p + "mlp.c_proj.bias"]
+        cl["transformer.final_layernorm.weight"] = sd["transformer.ln_f.weight"]
+        cl["transformer.final_layernorm.bias"] = sd["transformer.ln_f.bias"]
+        shards.append({"model": cl, "checkpoint_version": version,
+                       "mp_world_size": mp})
+    return shards
+
+
+class TestMegatronIngestion:
+    @pytest.mark.parametrize("version", [0, 1.0, 2.0])
+    @pytest.mark.parametrize("mp", [1, 2, 4])
+    def test_logit_parity_all_versions_and_mp(self, version, mp):
+        """mp-sharded Megatron checkpoints in every qkv version layout
+        load to HF-GPT2 logit parity."""
+        torch = pytest.importorskip("torch")
+        hf = _hf_gpt2()
+        shards = _megatron_shards_from_hf(hf, mp, version)
+        cfg, params = load_megatron_checkpoint(
+            shards, num_heads=NH, activation="gelu", dtype=jnp.float32,
+            loss_chunk=0)
+        assert cfg.num_layers == L and cfg.d_model == H
+        model = TransformerLM(cfg)
+        ids = np.random.RandomState(0).randint(0, V, (2, 16))
+        with torch.no_grad():
+            want = hf(torch.tensor(ids)).logits.numpy()
+        got = np.asarray(model.apply(params, jnp.asarray(ids)))
+        np.testing.assert_allclose(got, want, atol=2e-3)
+
+    def test_serve_at_different_tp_degree(self):
+        """The r4 'Done' bar: a Megatron checkpoint saved at mp=4 serves
+        at tp=2 with logit parity — resharding is the mesh's job, no file
+        surgery."""
+        torch = pytest.importorskip("torch")
+        hf = _hf_gpt2()
+        shards = _megatron_shards_from_hf(hf, mp=4, version=2.0)
+        cfg, params = load_megatron_checkpoint(
+            shards, num_heads=NH, activation="gelu", dtype=jnp.float32,
+            loss_chunk=0)
+        eng = ds.init_inference(
+            TransformerLM(cfg),
+            config={"dtype": "float32", "max_out_tokens": T,
+                    "tensor_parallel": {"tp_size": 2}},
+            params=params)
+        ids = np.random.RandomState(0).randint(0, V, (2, 16))
+        with torch.no_grad():
+            want = hf(torch.tensor(ids)).logits.numpy()
+        got = np.asarray(eng.forward(ids))
+        np.testing.assert_allclose(got, want, atol=2e-3)
+
+    @pytest.mark.parametrize("version", [0, 1.0, 2.0])
+    def test_split_merge_round_trip(self, version):
+        """Re-export splitter inverts the merge at every version."""
+        pytest.importorskip("torch")
+        hf = _hf_gpt2()
+        merged, _ = merge_megatron_state_dicts(
+            _megatron_shards_from_hf(hf, 1, 2.0), num_heads=NH)
+        reshard = split_megatron_state_dict(merged, 4, NH, version=version)
+        back, ver = merge_megatron_state_dicts(reshard, num_heads=NH)
+        assert ver == version
+        for k in merged:
+            np.testing.assert_array_equal(back[k], merged[k], err_msg=k)
+
+    def test_rejects_wrong_world_size_and_extra_keys(self):
+        pytest.importorskip("torch")
+        hf = _hf_gpt2()
+        shards = _megatron_shards_from_hf(hf, 2, 2.0)
+        with pytest.raises(ValueError, match="mp_world_size"):
+            merge_megatron_state_dicts(shards[:1], num_heads=NH)
+        shards = _megatron_shards_from_hf(hf, 1, 2.0)
+        shards[0]["model"]["transformer.layers.0.attn.rogue"] = np.ones(3)
+        with pytest.raises(ValueError, match="unconsumed"):
+            load_megatron_checkpoint(shards, num_heads=NH)
