@@ -321,11 +321,16 @@ def offload_bench(iters: int = 3):
 
     base = run({"stage": 0})
     off = run({"stage": 0, "offload_optimizer": {"device": "cpu"}})
+    # r5: the tier-1 grad wire rides the Infinity codec (offload_wire_bits)
+    off1 = run({"stage": 0, "offload_optimizer": {"device": "cpu"},
+                "offload_wire_bits": 1})
     print(json.dumps({
         "metric": "offload_tier1_tokens_per_sec",
-        "value": round(off, 1), "unit": "tokens/s",
+        "value": round(off1, 1), "unit": "tokens/s",
         "in_hbm_tokens_per_sec": round(base, 1),
-        "offload_vs_hbm": round(off / base, 4)}), flush=True)
+        "uncompressed_wire_tokens_per_sec": round(off, 1),
+        "wire1bit_speedup": round(off1 / off, 2),
+        "offload_vs_hbm": round(off1 / base, 4)}), flush=True)
 
 
 def infinity_bench(h2d_gbps: float, d2h_gbps: float):

@@ -281,14 +281,18 @@ class InfinityStepper:
                     f"got {type(model).__name__}")
         from ...parallel import topology as topo
         mesh = engine.mesh
-        for axis in (topo.MODEL_AXIS, topo.PIPE_AXIS, topo.SEQUENCE_AXIS,
-                     topo.EXPERT_AXIS):
+        for axis in (topo.MODEL_AXIS, topo.PIPE_AXIS, topo.SEQUENCE_AXIS):
             if mesh.shape.get(axis, 1) > 1:
                 raise NotImplementedError(
-                    f"ZeRO-Infinity composes with data-parallel sharding "
+                    f"ZeRO-Infinity composes with data-like sharding "
                     f"only; mesh axis '{axis}' has size "
-                    f"{mesh.shape[axis]} — use a pure dp mesh under "
+                    f"{mesh.shape[axis]} — use a data/expert mesh under "
                     f"offload_param, or drop offload for tp/pp/sp")
+        if mesh.shape.get(topo.EXPERT_AXIS, 1) > 1 and \
+                not getattr(model.config, "moe_enabled", False):
+            raise NotImplementedError(
+                "expert mesh axis under offload needs an MoE model (the "
+                "expert axis is data-like only for MoE's all_to_all)")
         if engine.fp16_enabled:
             raise NotImplementedError(
                 "ZeRO-Infinity requires bf16 (fp16 loss scaling is not "

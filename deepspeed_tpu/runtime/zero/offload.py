@@ -114,7 +114,8 @@ class ZeroOffloadHostOptimizer:
     def step_pipelined(self, grad_dev_leaves: List, shardings: List,
                        lr: float, grad_scale: float, emit_bf16: bool,
                        upload_dtype=None,
-                       bucket_bytes: int = 32 << 20) -> List:
+                       bucket_bytes: int = 32 << 20,
+                       fetch_fn=None) -> List:
         """Overlapped offload step (reference
         ``PipelinedOptimizerSwapper``, `pipelined_optimizer_swapper.py:55`):
         leaves are walked in buckets of ~``bucket_bytes`` so that bucket
@@ -124,7 +125,9 @@ class ZeroOffloadHostOptimizer:
 
         ``grad_dev_leaves`` — device arrays (fetch started with
         copy_to_host_async by the caller); returns the new device param
-        leaves in order."""
+        leaves in order. ``fetch_fn(k) -> np.ndarray`` overrides the
+        plain D2H fetch — the wire-codec path decodes the compressed
+        payload here instead (runtime/zero/wire_codec.py)."""
         from concurrent.futures import ThreadPoolExecutor
         if emit_bf16 and self._bf16 is None:
             self._bf16 = [np.empty(m.shape, np.uint16)
@@ -164,9 +167,12 @@ class ZeroOffloadHostOptimizer:
         if not hasattr(self, "_pool"):
             self._pool = ThreadPoolExecutor(max_workers=1,
                                             thread_name_prefix="offload-opt")
+        if fetch_fn is None:
+            def fetch_fn(k):
+                return np.asarray(grad_dev_leaves[k])                # D2H
         prev: Optional[tuple] = None
         for idxs in buckets:
-            ghosts = [np.asarray(grad_dev_leaves[k]) for k in idxs]  # D2H
+            ghosts = [fetch_fn(k) for k in idxs]
             fut = self._pool.submit(sweep, idxs, ghosts)
             if prev is not None:
                 # upload bucket i-1 on the main thread WHILE the worker
@@ -209,6 +215,15 @@ def validate_offload_config(cfg) -> str:
     z = cfg.zero_config
     oo, op = z.offload_optimizer, z.offload_param
     from ...runtime.config import OffloadDeviceEnum as E
+    bits = int(getattr(z, "offload_wire_bits", 0) or 0)
+    if bits and (oo is None or oo.device == E.none) and \
+            (op is None or op.device == E.none):
+        raise ValueError(
+            "zero_optimization.offload_wire_bits compresses the OFFLOAD "
+            "grad wire, but no offload is configured — set "
+            "offload_optimizer: {device: cpu} (tier 1) or offload_param "
+            "(Infinity), or drop offload_wire_bits (a silently ignored "
+            "knob is a bug)")
     if op is not None and op.device != E.none:
         # param offload → the ZeRO-Infinity streamed path; its own
         # validator enforces the rest (bf16, dense, adam, 1-chip)
@@ -231,4 +246,8 @@ def validate_offload_config(cfg) -> str:
             "on a multi-host mesh every process would gather full masters "
             "(device_get of non-addressable shards fails) — disable offload "
             "or run single-host")
+    if bits not in (0, 1, 4, 8):
+        raise ValueError(
+            f"zero_optimization.offload_wire_bits must be 0, 1, 4 or 8; "
+            f"got {bits}")
     return "optimizer"

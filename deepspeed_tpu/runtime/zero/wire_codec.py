@@ -55,10 +55,15 @@ def wire_bytes(n: int, bits: int) -> int:
 # device-side encode (jit-compiled by the caller)
 # ---------------------------------------------------------------------------
 def _chunk_scales(flat: jnp.ndarray, levels: float) -> jnp.ndarray:
-    """Per-chunk max-abs / levels; 0-chunks get scale 1 (payload is 0)."""
+    """Per-chunk max-abs / levels; 0-chunks get scale 1 (payload is 0).
+    A chunk containing NaN/Inf gets a NaN scale ON PURPOSE: the decode
+    then poisons the masters exactly like the uncompressed path would —
+    quantizing a diverged gradient into finite garbage would hide the
+    divergence (advisor r5)."""
     chunks = flat.reshape(-1, CHUNK)
     amax = jnp.max(jnp.abs(chunks), axis=1)
-    return jnp.where(amax > 0, amax / levels, 1.0)
+    s = jnp.where(amax > 0, amax / levels, 1.0)
+    return jnp.where(jnp.isfinite(amax), s, jnp.nan)
 
 
 def encode(flat: jnp.ndarray, bits: int, key: jnp.ndarray

@@ -103,6 +103,46 @@ class TestOffloadEngine:
                                "offload_optimizer": {"device": "cpu"}}))
         np.testing.assert_allclose(ref, off, rtol=1e-4)
 
+    @pytest.mark.parametrize("bits", [8, 1])
+    def test_offload_wire_codec_tracks_uncompressed(self, bits):
+        """r5: the tier-1 D2H grad wire rides the same stochastic-rounded
+        codec as ZeRO-Infinity's stream (offload_wire_bits). 8-bit must
+        track the uncompressed trajectory closely; 1-bit must stay finite
+        and actually train (loss drops)."""
+        _, ref = self._losses(base_config(
+            zero_optimization={"stage": 0,
+                               "offload_optimizer": {"device": "cpu"}}))
+        eng, wired = self._losses(base_config(
+            zero_optimization={"stage": 0,
+                               "offload_optimizer": {"device": "cpu"},
+                               "offload_wire_bits": bits}))
+        assert eng._offload_wire_bits == bits
+        assert all(np.isfinite(wired))
+        if bits == 8:
+            np.testing.assert_allclose(ref, wired, rtol=2e-2)
+        else:
+            assert wired[-1] < wired[0]
+
+    def test_offload_wire_codec_grad_parity_one_step(self):
+        """One 8-bit step: every master moves to within the quantization
+        noise of the uncompressed step (catches a payload/scale layout bug
+        that loss-level tracking could mask)."""
+        cfg = dict(zero_optimization={"stage": 0,
+                                      "offload_optimizer": {"device": "cpu"}})
+        e1, _ = self._losses(base_config(**cfg), n=1)
+        cfg["zero_optimization"]["offload_wire_bits"] = 8
+        e2, _ = self._losses(base_config(**cfg), n=1)
+        for a, b in zip(e1._host_opt.opt.master, e2._host_opt.opt.master):
+            np.testing.assert_allclose(a, b, atol=2e-3)
+
+    def test_offload_wire_bits_validated(self):
+        with pytest.raises(ValueError, match="offload_wire_bits"):
+            ds.initialize(model=tiny_model(), config=base_config(
+                zero_optimization={
+                    "stage": 0,
+                    "offload_optimizer": {"device": "cpu"},
+                    "offload_wire_bits": 3}), rng=jax.random.PRNGKey(0))
+
     def test_offload_with_zero2(self):
         _, off = self._losses(base_config(
             zero_optimization={"stage": 2,
