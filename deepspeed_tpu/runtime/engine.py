@@ -396,7 +396,13 @@ class DeepSpeedEngine:
 
         def fetch(k):
             if "buf" not in state:
-                state["buf"] = np.empty(n_chunks * CH, np.float32)
+                # persistent decode buffer: sized to the full master set,
+                # allocated once per engine (a fresh multi-GB np.empty per
+                # step would be recurring allocator cost on the hot path)
+                if getattr(self, "_wire_buf", None) is None or \
+                        self._wire_buf.shape[0] != n_chunks * CH:
+                    self._wire_buf = np.empty(n_chunks * CH, np.float32)
+                state["buf"] = self._wire_buf
                 state["payload"] = np.asarray(payload)        # one D2H
                 state["scales"] = np.asarray(scales)
             need = -(-int(offs[k + 1]) // CH)

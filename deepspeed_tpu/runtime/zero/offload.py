@@ -216,6 +216,11 @@ def validate_offload_config(cfg) -> str:
     oo, op = z.offload_optimizer, z.offload_param
     from ...runtime.config import OffloadDeviceEnum as E
     bits = int(getattr(z, "offload_wire_bits", 0) or 0)
+    if bits not in (0, 1, 4, 8):
+        # one copy of the range check, ahead of BOTH classifications
+        raise ValueError(
+            f"zero_optimization.offload_wire_bits must be 0, 1, 4 or 8; "
+            f"got {bits}")
     if bits and (oo is None or oo.device == E.none) and \
             (op is None or op.device == E.none):
         raise ValueError(
@@ -246,8 +251,4 @@ def validate_offload_config(cfg) -> str:
             "on a multi-host mesh every process would gather full masters "
             "(device_get of non-addressable shards fails) — disable offload "
             "or run single-host")
-    if bits not in (0, 1, 4, 8):
-        raise ValueError(
-            f"zero_optimization.offload_wire_bits must be 0, 1, 4 or 8; "
-            f"got {bits}")
     return "optimizer"
