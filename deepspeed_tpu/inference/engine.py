@@ -116,6 +116,12 @@ class InferenceEngine:
         self._fwd = None
         self._gen_fns: Dict[Tuple, Any] = {}
         self._latencies: list = []
+        # model-time profiling (reference inference/engine.py:159
+        # profile_model_time / :503 model_times): disabled until enabled,
+        # then every forward/generate call appends its synced wall time
+        self.model_profile_enabled = False
+        self._model_times: list = []
+        self._profiled_keys: set = set()
 
     def _cast(self, x):
         if jnp.issubdtype(x.dtype, jnp.floating):
@@ -284,11 +290,43 @@ class InferenceEngine:
                 self._fwd = jax.jit(
                     lambda p, s, ids: self.module.apply(
                         self._model_params(p, s), ids))
-        return self._fwd(self.params,
-                         getattr(self, "_scales", None),
-                         jnp.asarray(input_ids))
+        ids = jnp.asarray(input_ids)
+        # a fresh shape triggers trace+compile (seconds) — exclude it from
+        # the profile the way latency_stats drops its compile sample
+        first = ("fwd", ids.shape) not in self._profiled_keys
+        self._profiled_keys.add(("fwd", ids.shape))
+        t0 = (time.perf_counter()
+              if self.model_profile_enabled and not first else None)
+        out = self._fwd(self.params, getattr(self, "_scales", None), ids)
+        if t0 is not None:
+            out.block_until_ready()   # async dispatch would undercount
+            self._model_times.append(time.perf_counter() - t0)
+        return out
 
     __call__ = forward
+
+    # ------------------------------------------------------------------
+    # model-time profiling (reference inference/engine.py:159,503)
+    # ------------------------------------------------------------------
+    def profile_model_time(self) -> None:
+        """Start recording per-call model wall time; ``model_times``
+        drains the record. Device-synced (block_until_ready) the way the
+        reference syncs CUDA before/after the module call. Units: one
+        entry per engine call — a ``forward`` entry is one forward, a
+        ``generate`` entry is the WHOLE prefill+decode loop (the repo's
+        decode is one fused jit program, so there is no per-step hook);
+        calls that trigger a fresh trace+compile are excluded."""
+        self.model_profile_enabled = True
+
+    def model_times(self) -> list:
+        """Recorded model times since the last call, then resets —
+        reference semantics: raises if profiling was never enabled."""
+        if not self.model_profile_enabled:
+            raise RuntimeError(
+                "model profiling is not enabled — call "
+                "engine.profile_model_time() before timed calls")
+        times, self._model_times = self._model_times, []
+        return times
 
     # ------------------------------------------------------------------
     # generation
@@ -373,8 +411,18 @@ class InferenceEngine:
                  temperature: Optional[float] = None,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
                  eos_token_id: Optional[int] = None,
-                 rng: Optional[jax.Array] = None) -> jnp.ndarray:
+                 rng: Optional[jax.Array] = None,
+                 num_beams: int = 1) -> jnp.ndarray:
         """Prompt [B, T] → generated tokens [B, max_new_tokens]."""
+        if num_beams > 1:
+            # in-flight guard, reference inference/engine.py:544 _generate:
+            # beam search multiplies the KV workspace by num_beams and the
+            # decode kernels hold one cache line per sequence — reject
+            # loudly instead of silently decoding beam 0 only
+            raise NotImplementedError(
+                "num_beams > 1 is not supported: the decode path holds one "
+                "KV-cache line per batch row. Use sampling (temperature / "
+                "top_k / top_p) or expand the batch with repeated prompts.")
         ids = jnp.asarray(input_ids)
         temperature = (self.config.temperature if temperature is None
                        else temperature)
@@ -393,7 +441,8 @@ class InferenceEngine:
                 ids = jnp.pad(ids, ((0, 0), (0, padded - true_len)))
         key = (ids.shape[0], ids.shape[1], max_new_tokens, temperature,
                top_k, top_p, eos_token_id)
-        if key not in self._gen_fns:
+        compiled_now = key not in self._gen_fns
+        if compiled_now:
             self._gen_fns[key] = self._build_generate(*key)
         t0 = time.perf_counter()
         out = self._gen_fns[key](self.params, getattr(self, "_scales", None),
@@ -401,8 +450,10 @@ class InferenceEngine:
                                  rng if rng is not None
                                  else jax.random.PRNGKey(0))
         out.block_until_ready()
-        self._latencies.append(
-            (time.perf_counter() - t0) / max(max_new_tokens, 1))
+        dt = time.perf_counter() - t0
+        self._latencies.append(dt / max(max_new_tokens, 1))
+        if self.model_profile_enabled and not compiled_now:
+            self._model_times.append(dt)
         return out
 
     def latency_stats(self) -> Dict[str, float]:

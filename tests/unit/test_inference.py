@@ -130,6 +130,35 @@ class TestInferenceEngine:
         with pytest.raises(ValueError, match="max_out_tokens"):
             eng.generate(prompt(t=60), max_new_tokens=32)
 
+    def test_num_beams_rejected(self):
+        """Reference inference/engine.py:544 _generate: beam search is a
+        loud NotImplementedError, not a silent single-beam decode."""
+        eng = self._engine()
+        with pytest.raises(NotImplementedError, match="num_beams"):
+            eng.generate(prompt(), max_new_tokens=4, num_beams=4)
+        # num_beams=1 is the supported degenerate case
+        out = eng.generate(prompt(), max_new_tokens=4, temperature=0.0,
+                           num_beams=1)
+        assert out.shape == (2, 4)
+
+    def test_model_time_profiling(self):
+        """Reference profile_model_time/model_times semantics: disabled →
+        raises; enabled → every forward/generate appends a synced wall
+        time; reading drains the record."""
+        eng = self._engine()
+        with pytest.raises(RuntimeError, match="profile_model_time"):
+            eng.model_times()
+        eng.profile_model_time()
+        # first call per shape = trace+compile → excluded from the record
+        eng.forward(prompt())
+        eng.generate(prompt(), max_new_tokens=4, temperature=0.0)
+        assert eng.model_times() == []
+        eng.forward(prompt())
+        eng.generate(prompt(), max_new_tokens=4, temperature=0.0)
+        times = eng.model_times()
+        assert len(times) == 2 and all(t > 0 for t in times)
+        assert eng.model_times() == []   # drained
+
 
 class TestAutoTP:
     def test_auto_specs(self):
