@@ -142,6 +142,19 @@ class ZeroConfig(ConfigModel):
     # linear in total params: stochastic rounding is unbiased WITHOUT
     # error memory.
     offload_wire_bits: int = 0
+    # ZeRO-Infinity H2D parameter-wire compression: 0 = off (bf16 uploads),
+    # 8/4 = block-quantized parameter uploads (deterministic round-to-
+    # nearest, per-chunk max-abs scales; runtime/zero/wire_codec.py
+    # encode_params_host/decode_params). The streamed forward re-uploads
+    # every layer each step (the host sweep changed them), so on slow H2D
+    # links the upload wire bounds the step exactly like the reference's
+    # NVMe read path bounds its stage-3 prefetch
+    # (zero/partitioned_param_swapper). 8-bit halves upload bytes vs bf16
+    # AND doubles the device layer cache (the cache stores the quantized
+    # payload; dequant is fused into each layer's compiled program, an
+    # HBM-cheap read at 1 byte/param). The forward/backward compute sees
+    # the quantized weights; the f32 masters on the host stay exact.
+    offload_param_bits: int = 0
 
     @model_validator(mode="after")
     def _resolve_deprecated(self):

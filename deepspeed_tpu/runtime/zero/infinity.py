@@ -140,13 +140,26 @@ class InfinityStepper:
             raise ValueError(
                 f"zero_optimization.offload_wire_bits must be 0, 1, 4 or 8; "
                 f"got {self.wire_bits}")
-        quantum = self.dp * (wire_codec.CHUNK if self.wire_bits else 1)
+        # H2D param wire (offload_param_bits): quantized uploads + a
+        # quantized device cache; see runtime/config.py for the contract
+        self.param_bits = int(getattr(zc, "offload_param_bits", 0) or 0)
+        if self.param_bits not in (0, 4, 8):
+            raise ValueError(
+                f"zero_optimization.offload_param_bits must be 0, 4 or 8; "
+                f"got {self.param_bits}")
+        quantum = self.dp * (wire_codec.CHUNK
+                             if (self.wire_bits or self.param_bits) else 1)
         self.n_pad = -(-self.n_elems // quantum) * quantum
         # device layer-cache budget: how many streamed layers may stay
         # resident at once (2 = the minimal double-buffer; more turns the
-        # backward's re-uploads into cache hits when HBM allows)
+        # backward's re-uploads into cache hits when HBM allows). The
+        # config knob is in params-at-bf16; a quantized cache holds more
+        # layers in the same bytes, so account in bytes.
+        cache_bytes_pp = {0: 2.0, 8: 1.0, 4: 0.5}[self.param_bits]
+        budget_bytes = int(zc.max_live_parameters) * 2
+        per_layer_bytes = max(self.n_elems, 1) * cache_bytes_pp
         self.max_live_layers = int(np.clip(
-            int(zc.max_live_parameters) // max(self.n_elems, 1), 2, self.L))
+            int(budget_bytes / per_layer_bytes), 2, self.L))
         self._flat_shard = topo.batch_sharding(mesh)
         self._batch_shard = topo.batch_sharding(mesh)
         self._repl = topo.replicated(mesh)
@@ -228,8 +241,11 @@ class InfinityStepper:
         # device-side RNG state to checkpoint)
         self._wire_base = jax.random.PRNGKey(0x1bad)
         self._wire_seq = 0
-        self._dev: Dict[int, jax.Array] = {}     # slot -> device bf16 vector
-        self._pending_uploads: List[Tuple[int, jax.Array]] = []
+        # slot -> tuple of device arrays: (bf16 flat,) uncompressed, or
+        # (payload, scales) under the quantized param wire
+        self._dev: Dict[int, Tuple[jax.Array, ...]] = {}
+        # (slot|None, device arrays, host refs kept alive for the DMA)
+        self._pending_uploads: List[Tuple] = []
         # Host optimizer parallelism: one single-thread executor per worker,
         # layer i dispatched to worker i % N — per-layer ordering (accum of
         # microbatch j before j+1) is preserved while distinct layers sweep
@@ -259,10 +275,12 @@ class InfinityStepper:
             f"{host_gb:.1f} GiB, nvme {disk_gb:.1f} GiB "
             f"(params={op.device.value}, optimizer={oo.device.value}); "
             f"device layer cache {self.max_live_layers}/{self.L} layers "
-            f"(~{self.max_live_layers * self.n_pad * 2 / self.dp / 2**30:.2f}"
+            f"(~{self.max_live_layers * self.n_pad * cache_bytes_pp / self.dp / 2**30:.2f}"
             f" GiB/chip — zero_optimization.max_live_parameters bounds it)"
             + (f"; D2H wire {self.wire_bits}-bit stochastic-rounded"
-               if self.wire_bits else ""))
+               if self.wire_bits else "")
+            + (f"; H2D param wire {self.param_bits}-bit RTN"
+               if self.param_bits else ""))
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -423,32 +441,39 @@ class InfinityStepper:
         pinned host buffer when the DMA runs — releasing immediately would
         let the NVMe ring recycle the buffer under the transfer."""
         still = []
-        for slot, arr in self._pending_uploads:
+        for slot, arrs, refs in self._pending_uploads:
             if block:
-                jax.block_until_ready(arr)
-            if arr.is_ready():
-                self.param_store.release(slot, dirty=False)
+                for a in arrs:
+                    jax.block_until_ready(a)
+            if all(a.is_ready() for a in arrs):
+                if slot is not None:
+                    self.param_store.release(slot, dirty=False)
             else:
-                still.append((slot, arr))
+                still.append((slot, arrs, refs))
         self._pending_uploads = still
 
-    def _put_flat(self, host_bf16_local: np.ndarray) -> jax.Array:
-        """Upload the process-local span to the dp-sharded device vector.
-        Single-process: one sharded device_put (JAX slices per device).
-        Multi-host: each process contributes only its addressable shards."""
+    def _put_vec(self, host_local: np.ndarray, total: int) -> jax.Array:
+        """Upload the process-local span of a P(data)-sharded 1-D vector of
+        ``total`` elements (every wire vector's length divides evenly over
+        the dp axis by n_pad construction). Single-process: one sharded
+        device_put (JAX slices per device). Multi-host: each process
+        contributes only its addressable shards."""
         if jax.process_count() == 1:
-            return jax.device_put(host_bf16_local, self._flat_shard)
+            return jax.device_put(host_local, self._flat_shard)
+        lo0 = self._lo * total // self.n_pad   # local span start, scaled
         shards = []
-        imap = self._flat_shard.addressable_devices_indices_map(
-            (self.n_pad,))
+        imap = self._flat_shard.addressable_devices_indices_map((total,))
         for dev, idx in imap.items():
             sl = idx[0]
             lo = 0 if sl.start is None else int(sl.start)
-            hi = self.n_pad if sl.stop is None else int(sl.stop)
+            hi = total if sl.stop is None else int(sl.stop)
             shards.append(jax.device_put(
-                host_bf16_local[lo - self._lo:hi - self._lo], dev))
+                host_local[lo - lo0:hi - lo0], dev))
         return jax.make_array_from_single_device_arrays(
-            (self.n_pad,), self._flat_shard, shards)
+            (total,), self._flat_shard, shards)
+
+    def _put_flat(self, host_bf16_local: np.ndarray) -> jax.Array:
+        return self._put_vec(host_bf16_local, self.n_pad)
 
     def _fetch_flat(self, arr: jax.Array) -> np.ndarray:
         """bf16 device vector → host, process-local span only (the D2H wire
@@ -483,8 +508,9 @@ class InfinityStepper:
         wire_codec.decode_into(out, payload, scales, self.wire_bits,
                                accumulate=accumulate)
 
-    def _ensure_layer(self, i: int, keep) -> jax.Array:
-        """Device copy of layer i's sharded param vector, uploading from
+    def _ensure_layer(self, i: int, keep) -> Tuple[jax.Array, ...]:
+        """Device copy of layer i's sharded param vector — (bf16 flat,) or
+        (payload, scales) under the quantized param wire — uploading from
         the host store on miss. Eviction honours
         ``zero_optimization.max_live_parameters`` (reference stage3
         max_live_parameters budget): layers stay resident up to the budget
@@ -502,10 +528,23 @@ class InfinityStepper:
         self._sweep_uploads()
         buf = self.param_store.acquire(i)
         host = buf[:self.n_local * 2].view(ml_dtypes.bfloat16)
-        arr = self._put_flat(host)
-        self._pending_uploads.append((i, arr))  # pin held until transfer done
-        self._dev[i] = arr
-        return arr
+        if self.param_bits:
+            # quantized upload: encode from the pinned slot synchronously,
+            # then the async DMA reads the ENCODED arrays — the slot pin
+            # can drop immediately (refs keep the payload alive instead)
+            payload, scales = wire_codec.encode_params_host(
+                host, self.param_bits)
+            self.param_store.release(i, dirty=False)
+            pay_total = {8: self.n_pad, 4: self.n_pad // 2}[self.param_bits]
+            arrs = (self._put_vec(payload, pay_total),
+                    self._put_vec(scales, self.n_pad // wire_codec.CHUNK))
+            self._pending_uploads.append((None, arrs, (payload, scales)))
+        else:
+            arrs = (self._put_flat(host),)
+            # pin held until transfer done
+            self._pending_uploads.append((i, arrs, ()))
+        self._dev[i] = arrs
+        return arrs
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -547,10 +586,34 @@ class InfinityStepper:
         aux_coef = (float(getattr(c, "moe_aux_loss_coef", 0.0))
                     if getattr(c, "moe_enabled", False) else 0.0)
 
-        def block_fwd(flat, x):
+        def flat_fwd(flat, x):
             lp = self._unflatten(flat)
             y, _, laux = model._superblock(lp, x, None, None, None, True)
             return y, jnp.asarray(laux, jnp.float32)
+
+        pb = self.param_bits
+
+        if pb:
+            # quantized layer cache: each program takes (payload, scales)
+            # and fuses the dequant into the layer compute. block_vjp
+            # differentiates w.r.t. the DEQUANTIZED flat — that gradient
+            # is what the host sweep applies to the exact f32 masters
+            # (straight-through: d(dequant)/d(master) treated as identity,
+            # the standard QAT estimator; the quantization error is
+            # re-derived from the masters at every upload, never carried).
+            def block_fwd(payload, scales, x):
+                flat = wire_codec.decode_params(payload, scales, pb)
+                return flat_fwd(flat, x)
+
+            def block_vjp(payload, scales, x, dy):
+                flat = wire_codec.decode_params(payload, scales, pb)
+                (y, laux), vjp = jax.vjp(flat_fwd, flat, x)
+                del y, laux
+                dflat, dx = vjp((dy, jnp.asarray(aux_coef, jnp.float32)))
+                sq = jnp.sum(jnp.square(dflat.astype(jnp.float32)))
+                return dflat, dx, sq
+        else:
+            block_fwd = flat_fwd
 
         def head_loss(res, xL, ids, labels, mask):
             # mirrors model.loss's label/mask/chunk semantics
@@ -603,12 +666,13 @@ class InfinityStepper:
                 res, xL, ids, labels, mask)
             return loss, grads[0], grads[1]
 
-        def block_vjp(flat, x, dy):
-            (y, laux), vjp = jax.vjp(block_fwd, flat, x)
-            del y, laux
-            dflat, dx = vjp((dy, jnp.asarray(aux_coef, jnp.float32)))
-            sq = jnp.sum(jnp.square(dflat.astype(jnp.float32)))
-            return dflat, dx, sq
+        if not pb:
+            def block_vjp(flat, x, dy):
+                (y, laux), vjp = jax.vjp(flat_fwd, flat, x)
+                del y, laux
+                dflat, dx = vjp((dy, jnp.asarray(aux_coef, jnp.float32)))
+                sq = jnp.sum(jnp.square(dflat.astype(jnp.float32)))
+                return dflat, dx, sq
 
         def embed_vjp(res, ids, tt, dx):
             _, vjp = jax.vjp(lambda r: embed_fwd(r, ids, tt), res)
@@ -693,7 +757,7 @@ class InfinityStepper:
                 self._ensure_layer(i + 1, {i, i + 1})
             if stash:
                 acts[i] = x
-            x, la = progs["block_fwd"](self._dev[i], x)
+            x, la = progs["block_fwd"](*self._dev[i], x)
             aux = aux + la
         return acts, x, aux
 
@@ -732,7 +796,7 @@ class InfinityStepper:
         for i in reversed(range(self.L)):
             if i - 1 >= 0:
                 self._ensure_layer(i - 1, {i, i - 1})
-            dflat, dy, sq = progs["block_vjp"](self._dev[i], acts[i], dy)
+            dflat, dy, sq = progs["block_vjp"](*self._dev[i], acts[i], dy)
             acts[i] = None
             if self.wire_bits:
                 # quantize on device; only the packed payload + per-chunk

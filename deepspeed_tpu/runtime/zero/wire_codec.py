@@ -104,6 +104,59 @@ def encode(flat: jnp.ndarray, bits: int, key: jnp.ndarray
 
 
 # ---------------------------------------------------------------------------
+# H2D parameter wire (the opposite direction): host encodes, device decodes.
+#
+# Parameters are VALUES, not averaged quantities — stochastic rounding's
+# unbiasedness buys nothing (no accumulation to wash the variance out) and
+# would make consecutive forwards of unchanged weights disagree. So the
+# param wire uses deterministic round-to-nearest; the f32 masters on the
+# host remain exact and the quantization error is re-derived fresh from the
+# masters every upload (it never compounds step over step).
+# ---------------------------------------------------------------------------
+def encode_params_host(flat: np.ndarray, bits: int
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """bf16/f32 host vector [n] (n % CHUNK == 0) -> (payload uint8,
+    scales f32). 8-bit: n bytes; 4-bit: n/2 bytes (two nibbles/byte,
+    offset-7 code like the grad wire so decode is shared shape-wise)."""
+    n = flat.shape[0]
+    if n % CHUNK:
+        raise ValueError(f"param wire needs n % {CHUNK} == 0, got {n}")
+    levels = {8: 127.0, 4: 7.0}[bits]
+    x = np.asarray(flat, dtype=np.float32).reshape(-1, CHUNK)
+    amax = np.max(np.abs(x), axis=1)
+    s = np.where(amax > 0, amax / levels, 1.0).astype(np.float32)
+    # NaN/Inf chunks keep a NaN scale so a poisoned master poisons the
+    # device copy too instead of quantizing divergence into finite noise
+    s = np.where(np.isfinite(amax), s, np.nan).astype(np.float32)
+    with np.errstate(invalid="ignore"):   # NaN chunks: payload is garbage,
+        q = np.clip(np.rint(x / s[:, None]),  # the NaN scale carries the poison
+                    -levels, levels).astype(np.int8)
+    if bits == 8:
+        return q.reshape(-1).view(np.uint8), s
+    q4 = (q.reshape(-1, 2) + 7).astype(np.uint8)
+    return (q4[:, 0] | (q4[:, 1] << 4)), s
+
+
+def decode_params(payload: jnp.ndarray, scales: jnp.ndarray, bits: int,
+                  out_dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Device-side (jit-traceable) decode: (payload uint8, scales f32) ->
+    flat [n] in ``out_dtype``. Called INSIDE each layer's compiled
+    program so XLA fuses the dequant into the first consumers — the
+    bf16 flat never round-trips HBM as a separate pass."""
+    if bits == 8:
+        vals = jax.lax.bitcast_convert_type(
+            payload, jnp.int8).astype(jnp.float32)
+    elif bits == 4:
+        lo = (payload & 0x0F).astype(jnp.int32) - 7
+        hi = (payload >> 4).astype(jnp.int32) - 7
+        vals = jnp.stack([lo, hi], axis=-1).reshape(-1).astype(jnp.float32)
+    else:
+        raise ValueError(f"param wire bits={bits}")
+    vals = vals.reshape(-1, CHUNK) * scales[:, None]
+    return vals.reshape(-1).astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
 # host-side decode (numpy; the worker thread's side of the wire)
 # ---------------------------------------------------------------------------
 def decode_into(out: np.ndarray, payload: np.ndarray, scales: np.ndarray,
