@@ -46,6 +46,11 @@ class ReduceOp(Enum):
 
 _init_mode: Optional[str] = None  # None | "noop" | "explicit" | "auto"
 
+#: backends this stack can actually drive: collectives are traced into
+#: XLA programs, so the only "backend" is XLA itself (aliases accepted
+#: for porting convenience).
+SUPPORTED_DIST_BACKENDS = ("xla", "jax", "tpu")
+
 
 def init_distributed(dist_backend: str = "xla",
                      auto_mpi_discovery: bool = True,
@@ -60,8 +65,19 @@ def init_distributed(dist_backend: str = "xla",
     NUM_PROCESSES / PROCESS_ID env) we pass them through; otherwise on TPU we
     attempt argless auto-detection (pod metadata), falling back to
     single-process. A later call with explicit args upgrades a no-op init.
+
+    An unknown ``dist_backend`` is a loud ValueError, not a silent
+    fall-through: a ported DeepSpeed config naming 'nccl'/'gloo'/'mpi'
+    would otherwise appear to work while meaning something else entirely.
     """
     global _init_mode
+    if dist_backend is None or \
+            str(dist_backend).lower() not in SUPPORTED_DIST_BACKENDS:
+        raise ValueError(
+            f"unknown dist_backend {dist_backend!r}: this TPU-native stack "
+            f"drives all collectives through XLA — supported values: "
+            f"{', '.join(SUPPORTED_DIST_BACKENDS)} (DeepSpeed's "
+            f"'nccl'/'gloo'/'mpi' backends have no role here)")
     coordinator_address = coordinator_address or os.environ.get(
         "COORDINATOR_ADDRESS")
     env_np = os.environ.get("NUM_PROCESSES")

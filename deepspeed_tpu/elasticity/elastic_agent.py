@@ -14,6 +14,14 @@ through env (WORLD_SIZE/RANK/ELASTIC_RESTART_COUNT), and the valid world
 sizes come from the same v0.1/v0.2 solver the schedule uses
 (`elasticity/elasticity.py` compute_elastic_config) — so a shrink always
 lands on a world size whose batch configuration is legal.
+
+Liveness (runtime/resilience/heartbeat.py): with ``watchdog_timeout``
+set, each worker gets a per-generation heartbeat file via
+``DSTPU_HEARTBEAT_FILE`` and must touch it on its training cadence
+(``resilience.Heartbeat.maybe_beat``). A RUNNING worker whose heartbeat
+goes stale past the timeout is treated as hung — killed and fed into the
+same re-rendezvous path as a dead one. poll() alone cannot see a worker
+wedged in a collective; this can.
 """
 from __future__ import annotations
 
@@ -21,9 +29,11 @@ import dataclasses
 import os
 import signal
 import subprocess
+import tempfile
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..runtime.resilience import ENV_HEARTBEAT_FILE, Watchdog, beat
 from ..utils.logging import logger
 from .elasticity import ElasticityError, compute_elastic_config
 
@@ -52,13 +62,30 @@ class ElasticAgent:
                  initial_world_size: int,
                  monitor_interval: float = 0.2,
                  max_restarts: int = 3,
-                 on_rendezvous: Optional[Callable[[int, int], None]] = None):
+                 on_rendezvous: Optional[Callable[[int, int], None]] = None,
+                 watchdog_timeout: Optional[float] = None,
+                 heartbeat_dir: Optional[str] = None):
         self.spec = spec
         self.ds_config = ds_config
         self.initial_world = int(initial_world_size)
         self.monitor_interval = monitor_interval
         self.max_restarts = max_restarts
         self.on_rendezvous = on_rendezvous
+        # hung-worker watchdog: None defers to the master config's
+        # resilience block (resilience.watchdog_timeout_s); an explicit
+        # 0 disables it even when the config sets one
+        if watchdog_timeout is None:
+            watchdog_timeout = float(
+                (ds_config.get("resilience") or {}).get(
+                    "watchdog_timeout_s", 0.0))
+        self.watchdog_timeout = float(watchdog_timeout)
+        if self.watchdog_timeout < 0:
+            raise ValueError(
+                f"watchdog_timeout must be >= 0, got {watchdog_timeout}")
+        self._watchdog = (Watchdog(self.watchdog_timeout)
+                          if self.watchdog_timeout > 0 else None)
+        self._hb_dir = heartbeat_dir
+        self._hb_files: List[str] = []
         # validate config up front (loud reject beats dying mid-training)
         _, self.valid_worlds = compute_elastic_config(
             ds_config, world_size=0)
@@ -71,9 +98,17 @@ class ElasticAgent:
         return max(fits) if fits else None
 
     # -- worker group ------------------------------------------------------
+    def _heartbeat_path(self, generation: int, rank: int) -> str:
+        if self._hb_dir is None:
+            self._hb_dir = tempfile.mkdtemp(prefix="dstpu_elastic_hb_")
+        gen_dir = os.path.join(self._hb_dir, f"gen_{generation}")
+        os.makedirs(gen_dir, exist_ok=True)
+        return os.path.join(gen_dir, f"rank_{rank}")
+
     def _launch(self, world: int, generation: int
                 ) -> List[subprocess.Popen]:
         procs = []
+        self._hb_files = []
         for rank in range(world):
             env = dict(os.environ)
             env.update(self.spec.env or {})
@@ -83,11 +118,28 @@ class ElasticAgent:
                 "LOCAL_RANK": str(rank),
                 "ELASTIC_RESTART_COUNT": str(generation - 1),
             })
+            if self.watchdog_timeout > 0:
+                hb = self._heartbeat_path(generation, rank)
+                beat(hb)   # baseline: staleness counts from launch
+                env[ENV_HEARTBEAT_FILE] = hb
+                self._hb_files.append(hb)
             procs.append(subprocess.Popen(
                 list(self.spec.argv), env=env, cwd=self.spec.cwd))
         logger.info(f"elastic agent: generation {generation} launched "
-                    f"world_size={world}")
+                    f"world_size={world}" +
+                    (f" (watchdog {self.watchdog_timeout:.1f}s)"
+                     if self.watchdog_timeout > 0 else ""))
         return procs
+
+    def _hung_ranks(self, procs: List[subprocess.Popen],
+                    codes: List[Optional[int]]) -> List[int]:
+        """Ranks still RUNNING whose heartbeat file is stale past the
+        watchdog timeout (exited workers are judged by their code)."""
+        if self._watchdog is None or not self._hb_files:
+            return []
+        stale = set(self._watchdog.stale(self._hb_files))
+        return [i for i, c in enumerate(codes)
+                if c is None and i in stale]
 
     @staticmethod
     def _kill(procs: List[subprocess.Popen]) -> None:
@@ -121,6 +173,18 @@ class ElasticAgent:
             failed = False
             while True:
                 codes = [p.poll() for p in procs]
+                hung = self._hung_ranks(procs, codes)
+                if hung:
+                    # a hung worker becomes a dead one: SIGKILL gives it a
+                    # nonzero code, the normal shrink path does the rest
+                    logger.warning(
+                        f"elastic agent: worker rank(s) {hung} missed "
+                        f"heartbeats for > {self.watchdog_timeout:.1f}s in "
+                        f"generation {generation} — killing as hung")
+                    for i in hung:
+                        procs[i].kill()
+                        procs[i].wait()
+                    codes = [p.poll() for p in procs]
                 if any(c is not None and c != 0 for c in codes):
                     failed = True
                     break

@@ -56,6 +56,10 @@ class DeepSpeedInferenceConfig(ConfigModel):
     # kernel injection (reference replace_with_kernel_inject): use the
     # Pallas decode kernel on the token-at-a-time path
     replace_with_kernel_inject: bool = True
+    # profiling device syncs (profile_model_time) run under this timeout
+    # so a wedged device becomes a logged error, not a hang
+    # (runtime/resilience run_with_timeout); <= 0 disables the guard
+    profile_sync_timeout_s: float = 60.0
     # checkpoint to load params from (a deepspeed_tpu training checkpoint
     # dir, or None when the caller passes params directly)
     checkpoint: Optional[str] = None

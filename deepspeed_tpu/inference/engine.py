@@ -31,6 +31,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel import topology as topo
+from ..runtime.resilience import run_with_timeout
 from ..utils.logging import logger
 from .config import DeepSpeedInferenceConfig
 
@@ -299,9 +300,27 @@ class InferenceEngine:
               if self.model_profile_enabled and not first else None)
         out = self._fwd(self.params, getattr(self, "_scales", None), ids)
         if t0 is not None:
-            out.block_until_ready()   # async dispatch would undercount
-            self._model_times.append(time.perf_counter() - t0)
+            # async dispatch would undercount — sync, but under the
+            # resilience timeout guard: a wedged device drops the sample
+            # with a logged error instead of hanging the server
+            if self._guarded_sync(out):
+                self._model_times.append(time.perf_counter() - t0)
         return out
+
+    def _guarded_sync(self, out) -> bool:
+        """block_until_ready under the profile timeout guard. True iff
+        the sync completed (sample is valid)."""
+        timeout = self.config.profile_sync_timeout_s
+        if timeout <= 0:
+            out.block_until_ready()
+            return True
+        if run_with_timeout(out.block_until_ready, timeout):
+            return True
+        logger.error(
+            f"device sync did not complete within {timeout:.0f}s — "
+            f"dropping this profile sample (device wedged? raise "
+            f"profile_sync_timeout_s if the model is just that large)")
+        return False
 
     __call__ = forward
 
@@ -449,11 +468,16 @@ class InferenceEngine:
                                  ids, jnp.asarray(true_len, jnp.int32),
                                  rng if rng is not None
                                  else jax.random.PRNGKey(0))
-        out.block_until_ready()
+        if self.model_profile_enabled:
+            synced = self._guarded_sync(out)
+        else:
+            out.block_until_ready()
+            synced = True
         dt = time.perf_counter() - t0
-        self._latencies.append(dt / max(max_new_tokens, 1))
-        if self.model_profile_enabled and not compiled_now:
-            self._model_times.append(dt)
+        if synced:
+            self._latencies.append(dt / max(max_new_tokens, 1))
+            if self.model_profile_enabled and not compiled_now:
+                self._model_times.append(dt)
         return out
 
     def latency_stats(self) -> Dict[str, float]:

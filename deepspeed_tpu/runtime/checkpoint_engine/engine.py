@@ -30,6 +30,12 @@ import jax
 import numpy as np
 
 from ...utils.logging import logger
+from ..resilience import (CheckpointCorruptionError, FatalIOError,
+                          atomic_write_json, atomic_write_text,
+                          find_newest_verified_tag, fsync_dir,
+                          get_fault_injector, has_manifest,
+                          policy_from_config, retry_call, verify_manifest,
+                          write_manifest)
 
 _ASYNC_CKPTRS: Dict[int, Any] = {}
 
@@ -85,14 +91,17 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         # the per-rank zero checkpoint files, engine.py:3398)
         import orbax.checkpoint as ocp
         host_sd = engine._host_opt.state_dict()
+        # 0-d ndarrays, not numpy scalars: orbax >= 0.7 rejects scalar
+        # types (np.int64(x)) in StandardCheckpointHandler trees
         host_tree = {"arrays": host_sd["arrays"],
-                     "step_count": np.int64(host_sd["step_count"])}
+                     "step_count": np.asarray(host_sd["step_count"],
+                                              np.int64)}
         if engine._host_scaler is not None:
             s = engine._host_scaler
             host_tree["scaler"] = {
-                "scale": np.float64(s.scale),
-                "good_steps": np.int64(s.good_steps),
-                "hysteresis": np.int64(s.hysteresis)}
+                "scale": np.asarray(s.scale, np.float64),
+                "good_steps": np.asarray(s.good_steps, np.int64),
+                "hysteresis": np.asarray(s.hysteresis, np.int64)}
         ocp.Checkpointer(ocp.StandardCheckpointHandler()).save(
             os.path.join(path, "host_opt"), host_tree, force=True)
 
@@ -107,6 +116,7 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "client_state": client_state or {},
         "ds_version": "deepspeed_tpu-0.1.0",
     }
+    resilience = getattr(engine._config, "resilience", None)
     if async_save:
         # A tag dir must be complete iff the state committed: defer BOTH the
         # meta.json write and the 'latest' publish until the background
@@ -120,9 +130,10 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             import atexit
             atexit.register(wait_pending)
             _ATEXIT_REGISTERED = True
-        _PENDING_TAGS.append((os.path.abspath(save_dir), tag, meta))
+        _PENDING_TAGS.append((os.path.abspath(save_dir), tag, meta,
+                              resilience))
     else:
-        _publish(os.path.abspath(save_dir), tag, meta)
+        _publish(os.path.abspath(save_dir), tag, meta, resilience)
     logger.info(f"saved checkpoint {path}" +
                 (" (async)" if async_save else ""))
     return path
@@ -132,14 +143,35 @@ _PENDING_TAGS: list = []
 _ATEXIT_REGISTERED = False
 
 
-def _publish(save_dir: str, tag: str, meta: dict) -> None:
-    """Make a tag dir loadable: write meta.json, point 'latest' at it."""
+def _publish(save_dir: str, tag: str, meta: dict, resilience=None) -> None:
+    """Commit a tag: meta.json + integrity manifest, then point 'latest'
+    at it — each file write-tmp → fsync → rename → fsync(dir), so a crash
+    at any instant leaves either the previous committed checkpoint or
+    this one, never a torn state. 'latest' moves only AFTER the manifest
+    exists (and, with verify_on_save, re-verifies) — the Nebula commit
+    contract (`nebula_checkpoint_engine.py:15`) made explicit."""
     path = _tag_path(save_dir, tag)
     os.makedirs(path, exist_ok=True)
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump(meta, f, indent=2, default=str)
-    with open(os.path.join(save_dir, "latest"), "w") as f:
-        f.write(str(tag))
+    integrity = resilience is None or resilience.checkpoint_integrity
+    verify = resilience is None or resilience.verify_on_save
+
+    def _commit():
+        get_fault_injector().check("checkpoint.publish", path=path)
+        atomic_write_json(os.path.join(path, "meta.json"), meta,
+                          indent=2, default=str)
+        if integrity:
+            write_manifest(path, extra={"tag": str(tag)})
+            if verify:
+                ok, problems = verify_manifest(path)
+                if not ok:
+                    raise FatalIOError(
+                        f"checkpoint {path} failed post-commit "
+                        f"verification: {'; '.join(problems[:5])}")
+        atomic_write_text(os.path.join(save_dir, "latest"), str(tag))
+        fsync_dir(save_dir)
+
+    retry_call(_commit, policy=policy_from_config(resilience),
+               what=f"checkpoint publish '{tag}'")
 
 
 def wait_pending(engine=None) -> None:
@@ -169,6 +201,54 @@ def _validate_tag(engine, save_dir: str, tag: Optional[str]):
     return tag
 
 
+def _resolve_verified_tag(engine, load_dir: str, tag: str,
+                          explicit: bool) -> str:
+    """Integrity gate on load: verify the tag's manifest; on a corrupt or
+    partial tag, fall back to the newest tag that still verifies (loud
+    warning) — unless the caller named the tag explicitly, in which case
+    silently loading a different checkpoint would be worse than failing."""
+    rz = getattr(engine._config, "resilience", None)
+    if rz is not None and not rz.checkpoint_integrity:
+        return tag
+    path = _tag_path(load_dir, tag)
+    if not os.path.isdir(path):
+        # a dangling 'latest' (tag dir deleted by hand after a corruption
+        # report, partial copy) is just another corruption shape — it
+        # must reach the same fallback, not a bare FileNotFoundError
+        ok, problems = False, [f"tag dir {path} is missing"]
+    else:
+        ok, problems = verify_manifest(path)
+    if ok:
+        return tag
+    if os.path.isdir(path) and not has_manifest(path) and \
+            os.path.exists(os.path.join(path, "meta.json")):
+        # pre-integrity-layer save: loadable but unverifiable
+        logger.warning(
+            f"checkpoint tag {tag!r} has no integrity manifest "
+            f"(saved before the resilience layer?) — loading unverified")
+        return tag
+    logger.error(
+        f"checkpoint tag {tag!r} in {load_dir} FAILED integrity "
+        f"verification: {'; '.join(problems[:5])}")
+    if not explicit and (rz is None or rz.fallback_to_last_good):
+        fb = find_newest_verified_tag(load_dir, exclude=(tag,),
+                                      require_manifest=False)
+        if fb is not None:
+            logger.warning(
+                f"FALLING BACK to newest verified checkpoint tag {fb!r} "
+                f"(the run loses the steps between {fb!r} and the corrupt "
+                f"{tag!r})")
+            return fb
+    if explicit and not os.path.isdir(path):
+        # an explicitly named tag that simply is not there keeps the
+        # classic error type
+        raise FileNotFoundError(f"checkpoint {path} not found")
+    raise CheckpointCorruptionError(
+        f"checkpoint tag {tag!r} in {load_dir} is corrupt/partial "
+        f"({'; '.join(problems[:5])}) and no verified fallback tag "
+        f"{'was allowed' if explicit else 'exists'}")
+
+
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_optimizer_states: bool = True,
                     load_lr_scheduler_states: bool = True,
@@ -176,11 +256,15 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     """Restore into the engine's CURRENT shardings (topology may differ from
     the saving job — orbax reshards on read)."""
     wait_pending()
+    explicit = tag is not None
     tag = _validate_tag(engine, load_dir, tag)
     if tag is None:
         return None, {}
+    tag = _resolve_verified_tag(engine, load_dir, tag, explicit)
     path = _tag_path(load_dir, tag)
     if not os.path.isdir(path):
+        # reachable only with checkpoint_integrity disabled (the resolver
+        # otherwise falls back or raises CheckpointCorruptionError)
         raise FileNotFoundError(f"checkpoint {path} not found")
 
     import orbax.checkpoint as ocp
@@ -207,11 +291,18 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         restore_args = ocp.checkpoint_utils.construct_restore_args(
             params_target)
         ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
-        restored = ckptr.restore(
-            os.path.join(path, "state"),
-            args=ocp.args.PyTreeRestore(item=params_target,
-                                        restore_args=restore_args,
-                                        partial_restore=True))
+        try:
+            restore = ocp.args.PyTreeRestore(item=params_target,
+                                             restore_args=restore_args,
+                                             partial_restore=True)
+        except TypeError:
+            # orbax < 0.9 has no partial_restore kwarg; an empty
+            # transforms dict (default-to-original) restores exactly the
+            # item's keys — same partial-restore semantics
+            restore = ocp.args.PyTreeRestore(item=params_target,
+                                             restore_args=restore_args,
+                                             transforms={})
+        restored = ckptr.restore(os.path.join(path, "state"), args=restore)
         engine.state["params"] = restored["params"]
         engine.state["step"] = restored["step"]
     else:

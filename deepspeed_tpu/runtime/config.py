@@ -313,6 +313,67 @@ class CommsConfig(ConfigModel):
     prof_ops: list = Field(default_factory=list)
 
 
+class ResilienceConfig(ConfigModel):
+    """``resilience`` block (runtime/resilience/, docs/resilience.md).
+
+    Governs checkpoint integrity (manifest + atomic commit + last-good
+    fallback), the shared I/O retry policy, non-finite-gradient step
+    skipping, worker liveness, and deterministic fault injection."""
+    # -- checkpoint integrity --
+    checkpoint_integrity: bool = C.RESILIENCE_CHECKPOINT_INTEGRITY_DEFAULT
+    # re-read and re-fingerprint every artifact right after commit; the
+    # paranoid mode that catches a lying write cache at save time
+    verify_on_save: bool = C.RESILIENCE_VERIFY_ON_SAVE_DEFAULT
+    # on a corrupt/partial tag at load, fall back to the newest tag that
+    # still verifies instead of raising
+    fallback_to_last_good: bool = C.RESILIENCE_FALLBACK_DEFAULT
+    # -- retriable I/O (runtime/resilience/retry.py) --
+    io_retry_attempts: int = C.RESILIENCE_IO_RETRY_ATTEMPTS_DEFAULT
+    io_retry_base_delay_s: float = C.RESILIENCE_IO_RETRY_BASE_DELAY_DEFAULT
+    io_retry_max_delay_s: float = C.RESILIENCE_IO_RETRY_MAX_DELAY_DEFAULT
+    io_retry_jitter: float = C.RESILIENCE_IO_RETRY_JITTER_DEFAULT
+    # -- training-step hygiene --
+    # skip the optimizer update (and count it in state['skipped']) when
+    # the global grad norm is non-finite, instead of poisoning opt state
+    skip_nonfinite_grad_steps: bool = C.RESILIENCE_SKIP_NONFINITE_DEFAULT
+    # -- liveness (elasticity/elastic_agent.py watchdog) --
+    heartbeat_interval_s: float = C.RESILIENCE_HEARTBEAT_INTERVAL_DEFAULT
+    watchdog_timeout_s: float = C.RESILIENCE_WATCHDOG_TIMEOUT_DEFAULT  # 0=off
+    # -- fault injection (runtime/resilience/fault_injection.py) --
+    # {"site": {"kind": "fail|fatal|truncate|delay|kill",
+    #           "at": 1, "count": 1, "arg": 0}}
+    fault_injection: Dict[str, Any] = Field(default_factory=dict)
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.io_retry_attempts < 1:
+            raise ValueError(
+                f"resilience.io_retry_attempts must be >= 1, got "
+                f"{self.io_retry_attempts}")
+        if self.io_retry_base_delay_s < 0 or \
+                self.io_retry_max_delay_s < self.io_retry_base_delay_s:
+            raise ValueError(
+                "resilience: need 0 <= io_retry_base_delay_s <= "
+                f"io_retry_max_delay_s, got {self.io_retry_base_delay_s}/"
+                f"{self.io_retry_max_delay_s}")
+        if not 0.0 <= self.io_retry_jitter <= 1.0:
+            raise ValueError(
+                f"resilience.io_retry_jitter must be in [0, 1], got "
+                f"{self.io_retry_jitter}")
+        if self.watchdog_timeout_s < 0 or self.heartbeat_interval_s <= 0:
+            raise ValueError(
+                "resilience: watchdog_timeout_s must be >= 0 (0 disables) "
+                "and heartbeat_interval_s > 0")
+        if self.watchdog_timeout_s and \
+                self.watchdog_timeout_s < 2 * self.heartbeat_interval_s:
+            raise ValueError(
+                f"resilience.watchdog_timeout_s "
+                f"({self.watchdog_timeout_s}) must be at least twice "
+                f"heartbeat_interval_s ({self.heartbeat_interval_s}) or a "
+                f"healthy worker one beat behind gets killed")
+        return self
+
+
 # ---------------------------------------------------------------------------
 # Master config
 # ---------------------------------------------------------------------------
@@ -380,6 +441,7 @@ class DeepSpeedConfig:
         )
         self.checkpoint_config = CheckpointConfig(**g(C.CHECKPOINT, {}))
         self.comms_config = CommsConfig(**g("comms_logger", {}))
+        self.resilience = ResilienceConfig(**g(C.RESILIENCE, {}))
 
         # Late imports to avoid cycles; these blocks are parsed by their
         # subsystems on first use.

@@ -64,6 +64,7 @@ SEQUENCE_PARALLEL = "sequence_parallel"
 MESH = "mesh"
 CHECKPOINT = "checkpoint"
 TENSOR_PARALLEL = "tensor_parallel"
+RESILIENCE = "resilience"
 
 #############################################
 # Defaults
@@ -85,6 +86,18 @@ FP16_INITIAL_SCALE_POWER_DEFAULT = 16
 FP16_LOSS_SCALE_WINDOW_DEFAULT = 1000
 FP16_HYSTERESIS_DEFAULT = 2
 FP16_MIN_LOSS_SCALE_DEFAULT = 1.0
+
+# Resilience block defaults (runtime/resilience/, docs/resilience.md).
+RESILIENCE_CHECKPOINT_INTEGRITY_DEFAULT = True
+RESILIENCE_VERIFY_ON_SAVE_DEFAULT = True
+RESILIENCE_FALLBACK_DEFAULT = True
+RESILIENCE_IO_RETRY_ATTEMPTS_DEFAULT = 3
+RESILIENCE_IO_RETRY_BASE_DELAY_DEFAULT = 0.05   # seconds
+RESILIENCE_IO_RETRY_MAX_DELAY_DEFAULT = 2.0     # seconds
+RESILIENCE_IO_RETRY_JITTER_DEFAULT = 0.25       # fraction of each delay
+RESILIENCE_SKIP_NONFINITE_DEFAULT = True
+RESILIENCE_HEARTBEAT_INTERVAL_DEFAULT = 1.0     # seconds
+RESILIENCE_WATCHDOG_TIMEOUT_DEFAULT = 0.0       # seconds; 0 disables
 
 ROUTE_TRAIN = "train"
 ROUTE_EVAL = "eval"

@@ -41,6 +41,7 @@ from . import lr_schedules
 from .config import DeepSpeedConfig
 from .fp16 import DynamicLossScaler, static_loss_scaler
 from .optimizers import Optimizer, get_optimizer, wrap_optax
+from .resilience import Heartbeat
 from .zero.sharding import ZeroShardingPolicy, constrain, to_named
 
 MEM_EFFICIENT_LINEAR_DEFAULT = True
@@ -73,6 +74,17 @@ class DeepSpeedEngine:
         self.model = model
         self._config = (config if isinstance(config, DeepSpeedConfig)
                         else DeepSpeedConfig(config or {}))
+        if self._config.resilience.fault_injection:
+            # config-driven fault plans arm the process-global injector
+            # (runtime/resilience; env DSTPU_FAULTS plans merge on top)
+            from .resilience import get_fault_injector
+            get_fault_injector().add_plans_from_config(
+                self._config.resilience.fault_injection)
+        # worker side of the elastic agent's hung-worker watchdog: beat
+        # the DSTPU_HEARTBEAT_FILE the agent assigned us once per
+        # interval at every train step (no-op when launched standalone)
+        self._heartbeat = Heartbeat(
+            interval_s=self._config.resilience.heartbeat_interval_s)
         self.mesh = mesh if mesh is not None else topo.build_mesh(
             self._config.mesh)
         self.dp_world_size = topo.dp_world_size(self.mesh)
@@ -433,9 +445,13 @@ class DeepSpeedEngine:
 
         denom = scale * gas
         gnorm = float(gnorm_raw) / denom
+        # a non-finite norm skips the host sweep either because the fp16
+        # scaler says so or because resilience hygiene does (bf16 offload
+        # runs have no scaler but the same poisoned-masters failure mode)
         overflow = (not np.isfinite(gnorm)) and \
-            (self._host_scaler is not None
-             and self._host_scaler.detect_overflow)
+            ((self._host_scaler is not None
+              and self._host_scaler.detect_overflow)
+             or cfg.resilience.skip_nonfinite_grad_steps)
         step_i = int(self.state["step"])
         if overflow:
             self.state["skipped"] = self.state["skipped"] + 1
@@ -551,6 +567,13 @@ class DeepSpeedEngine:
                 overflow = jnp.asarray(False)
 
         gnorm = global_norm(grads)
+        if cfg.resilience.skip_nonfinite_grad_steps:
+            # a NaN/Inf global norm means the update would poison params
+            # AND optimizer moments — skip the step and count it in
+            # state['skipped'] (the fp16 scaler catches this only when a
+            # scaler exists; bf16/fp32 runs need the same protection)
+            overflow = jnp.logical_or(jnp.asarray(overflow),
+                                      jnp.logical_not(jnp.isfinite(gnorm)))
         if cfg.gradient_clipping and cfg.gradient_clipping > 0:
             clip = jnp.asarray(cfg.gradient_clipping, jnp.float32)
             factor = jnp.minimum(1.0, clip / jnp.maximum(gnorm, 1e-6))
@@ -800,6 +823,7 @@ class DeepSpeedEngine:
     def train_step(self, batch: Dict) -> Dict:
         """One full optimizer step (gas microbatches). Returns metrics dict
         of device scalars."""
+        self._heartbeat.maybe_beat()
         if self.infinity_enabled:
             self.tput_timer.start()
             metrics = self._infinity.train_step(batch)
@@ -808,6 +832,9 @@ class DeepSpeedEngine:
             self.micro_steps += self.gradient_accumulation_steps
             if self._config.wall_clock_breakdown:
                 self._step_times.append(metrics["step_time"])
+            # on the ENGINE (the stepper keeps its own copy) — this is
+            # what get_global_grad_norm() reads
+            self._last_metrics = metrics
             self._post_step_observe(metrics, batch)
             return metrics
         if self.offload_enabled:
@@ -856,6 +883,9 @@ class DeepSpeedEngine:
         if self._config.wall_clock_breakdown:
             jax.block_until_ready(metrics["loss"])
             self._step_times.append(time.perf_counter() - t0)
+        # keep get_global_grad_norm() current: the compat step() path and
+        # the offload/infinity paths set this too
+        self._last_metrics = metrics
         self._post_step_observe(metrics, batch)
         return metrics
 
@@ -1007,6 +1037,7 @@ class DeepSpeedEngine:
         return self._grad_acc_count >= self.gradient_accumulation_steps
 
     def step(self) -> None:
+        self._heartbeat.maybe_beat()
         if self._grad_acc is None:
             return
         if self._apply_fn is None:
@@ -1051,8 +1082,17 @@ class DeepSpeedEngine:
     # checkpointing lives in runtime/checkpoint_engine (wired by __init__.py)
     def save_checkpoint(self, save_dir, tag=None, client_state=None):
         from .checkpoint_engine.engine import save_checkpoint as _save
-        return _save(self, save_dir, tag=tag, client_state=client_state or {})
+        # a multi-GB checkpoint write is the longest legitimate gap
+        # between train steps — bracket it with beats so the elastic
+        # agent's watchdog doesn't read it as a hang
+        self._heartbeat.beat_now()
+        try:
+            return _save(self, save_dir, tag=tag,
+                         client_state=client_state or {})
+        finally:
+            self._heartbeat.beat_now()
 
     def load_checkpoint(self, load_dir, tag=None, **kw):
         from .checkpoint_engine.engine import load_checkpoint as _load
+        self._heartbeat.maybe_beat()
         return _load(self, load_dir, tag=tag, **kw)

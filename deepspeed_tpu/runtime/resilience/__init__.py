@@ -1,0 +1,46 @@
+"""Fault-tolerance layer: failure taxonomy, retriable I/O, checkpoint
+integrity, fault injection, and worker liveness.
+
+The production story this subsystem exists for (ROADMAP north star —
+serving/training at fleet scale): a torn checkpoint write must not
+poison a run, a transient NVMe/host-store error must not kill it, and a
+hung worker must be detected, not just a dead one. The reference ships
+tag validation and Nebula committed checkpoints for the same reasons;
+this is the TPU-native equivalent plus the fault-injection harness that
+keeps the failure paths tested.
+
+Wired into: runtime/checkpoint_engine (atomic commit + manifest +
+last-good fallback), runtime/zero/infinity + runtime/swap_tensor
+(retriable slot I/O), elasticity/elastic_agent (heartbeat watchdog),
+runtime/engine (non-finite grad-norm skip-step), inference/engine
+(device-sync timeout guard). Config: the ``resilience`` block
+(runtime/config.py); docs: docs/resilience.md.
+"""
+from .errors import (CheckpointCorruptionError, FatalIOError,
+                     TRANSIENT_ERRNOS, TransientIOError, is_transient)
+from .fault_injection import (ENV_FAULTS, FaultInjector, FaultPlan,
+                              get_fault_injector, install_fault_injector)
+from .heartbeat import (ENV_HEARTBEAT_FILE, Heartbeat, Watchdog, beat,
+                        heartbeat_age, is_stale, run_with_timeout)
+from .integrity import (MANIFEST_NAME, atomic_write_bytes,
+                        atomic_write_json, atomic_write_text,
+                        file_checksum, find_newest_verified_tag, fsync_dir,
+                        has_manifest, list_tags, verify_manifest,
+                        write_manifest)
+from .retry import (DEFAULT_IO_POLICY, RetryPolicy, policy_from_config,
+                    retriable, retry_call)
+
+__all__ = [
+    "CheckpointCorruptionError", "FatalIOError", "TRANSIENT_ERRNOS",
+    "TransientIOError", "is_transient",
+    "ENV_FAULTS", "FaultInjector", "FaultPlan", "get_fault_injector",
+    "install_fault_injector",
+    "ENV_HEARTBEAT_FILE", "Heartbeat", "Watchdog", "beat", "heartbeat_age",
+    "is_stale", "run_with_timeout",
+    "MANIFEST_NAME", "atomic_write_bytes", "atomic_write_json",
+    "atomic_write_text", "file_checksum", "find_newest_verified_tag",
+    "fsync_dir", "has_manifest", "list_tags", "verify_manifest",
+    "write_manifest",
+    "DEFAULT_IO_POLICY", "RetryPolicy", "policy_from_config", "retriable",
+    "retry_call",
+]

@@ -31,11 +31,17 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ...ops.aio import (ALIGN, AsyncIOHandle, PinnedBuffer, round_up)
+from ..resilience import get_fault_injector, retry_call
 from ...utils.logging import logger
 
 
 class SlotStore:
     """Abstract fixed-stride slot store."""
+
+    #: optional RetryPolicy for transient-I/O retries on the file-backed
+    #: tiers (None = runtime/resilience DEFAULT_IO_POLICY). Set by the
+    #: owner (InfinityStepper wires the config-derived policy in).
+    io_policy = None
 
     def __init__(self, n_slots: int, slot_nbytes: int):
         self.n_slots = int(n_slots)
@@ -189,6 +195,48 @@ class NvmeSlotStore(SlotStore):
                         f"acquired for {self.PIN_WAIT_TIMEOUT:.0f}s — raise "
                         f"buffer_count (acquire/release imbalance otherwise)")
 
+    def _backoff_sleep(self, delay: float) -> None:
+        """Retry backoff for in-lock submissions: waiting on the store
+        condition releases the RLock (all recursion levels) for the
+        duration, so the concurrent stream/optimizer thread is not
+        stalled for the whole retry budget. Spurious wakeups just retry
+        the submission early — harmless."""
+        self._cond.wait(delay)
+
+    def _submit_with_retry(self, b: int, submit, what: str):
+        """Run one aio submission under the retry budget. The buffer is
+        PINNED across the attempts: the backoff sleep releases the lock,
+        and an unpinned buffer would be up for grabs to a concurrent
+        _free_buf the moment it does."""
+        self._buf_pins[b] += 1
+        try:
+            return retry_call(submit, policy=self.io_policy, what=what,
+                              sleep=self._backoff_sleep)
+        finally:
+            self._buf_pins[b] -= 1
+            if self._buf_pins[b] == 0:
+                self._cond.notify_all()
+
+    def _submit_read(self, b: int, slot: int):
+        """pread submission through the shared retry policy + the
+        ``slot_store.read`` fault site. Submission failures (bad fd,
+        queue full → EAGAIN/EBUSY, injected faults) are the retriable
+        surface; completion errors surface in wait_op."""
+        def _do():
+            get_fault_injector().check("slot_store.read", path=self.path)
+            return self.aio.pread(self._bufs[b].array, self.path,
+                                  slot * self.stride)
+        return self._submit_with_retry(
+            b, _do, f"nvme slot read [{self.path}:{slot}]")
+
+    def _submit_write(self, b: int, slot: int):
+        def _do():
+            get_fault_injector().check("slot_store.write", path=self.path)
+            return self.aio.pwrite(self._bufs[b].array, self.path,
+                                   slot * self.stride)
+        return self._submit_with_retry(
+            b, _do, f"nvme slot write [{self.path}:{slot}]")
+
     # -- API --------------------------------------------------------------
     def prefetch(self, slot: int) -> None:
         with self._lock:
@@ -200,8 +248,15 @@ class NvmeSlotStore(SlotStore):
                 # may have mapped this slot meanwhile; keep its mapping
                 # (buffer b stays unpinned/unmapped for the next scan)
                 return
-            self._buf_op[b] = self.aio.pread(
-                self._bufs[b].array, self.path, slot * self.stride)
+            op = self._submit_read(b, slot)
+            if slot in self._slot_buf:
+                # the retry backoff also releases the lock: a peer mapped
+                # this slot while we were sleeping. Keep theirs; register
+                # our duplicate read on b (so _free_buf drains it before
+                # reuse) but leave b unmapped.
+                self._buf_op[b] = op
+                return
+            self._buf_op[b] = op
             self._buf_slot[b] = slot
             self._slot_buf[slot] = b
 
@@ -224,8 +279,7 @@ class NvmeSlotStore(SlotStore):
                 if self._buf_pins[b] == 0:
                     self._cond.notify_all()
             if dirty:
-                self._buf_op[b] = self.aio.pwrite(
-                    self._bufs[b].array, self.path, slot * self.stride)
+                self._buf_op[b] = self._submit_write(b, slot)
             # buffer stays mapped (clean cache) until the ring reclaims it
 
     def flush(self) -> None:

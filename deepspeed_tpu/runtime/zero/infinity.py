@@ -77,7 +77,31 @@ import ml_dtypes
 import numpy as np
 
 from ...utils.logging import logger
+from ..resilience import get_fault_injector, policy_from_config, retry_call
 from . import wire_codec
+
+
+def _savez_retry(path: str, policy=None, **arrays) -> None:
+    """One slot .npz write through the shared retry policy + the
+    ``infinity.slot_write`` fault-injection site. A partial write that
+    failed is simply overwritten by the retry (np.savez truncates)."""
+    def _write():
+        get_fault_injector().check("infinity.slot_write", path=path)
+        np.savez(path, **arrays)
+    retry_call(_write, policy=policy,
+               what=f"infinity slot write {os.path.basename(path)}")
+
+
+def _load_npz_retry(path: str, policy=None):
+    """Open a slot .npz through the retry policy + the
+    ``infinity.slot_read`` site. Retries cover the open; a truncated
+    archive surfaces at member read and is the integrity layer's job
+    (checkpoint manifest), not the retry layer's."""
+    def _open():
+        get_fault_injector().check("infinity.slot_read", path=path)
+        return np.load(path)
+    return retry_call(_open, policy=policy,
+                      what=f"infinity slot read {os.path.basename(path)}")
 
 
 def _flatten_info(tpl):
@@ -207,12 +231,22 @@ class InfinityStepper:
             if threading.current_thread() is self._stream_thread:
                 self._sweep_uploads(block=True)
         self.param_store.reclaim = _reclaim
+        # shared transient-I/O retry policy for the slot streams
+        # (runtime/resilience; the host/NVMe tiers are the I/O surface a
+        # multi-day run actually hits)
+        self._io_policy = policy_from_config(
+            getattr(cfg, "resilience", None))
+        self._skip_nonfinite = bool(
+            getattr(cfg, "resilience", None) is not None
+            and cfg.resilience.skip_nonfinite_grad_steps)
+        self.param_store.io_policy = self._io_policy
         self.opt = SlotOptimizer(
             self.L, self.n_local, device=oo.device.value,
             nvme_path=oo.nvme_path, aio=shared_aio,
             buffer_count=max(3, oo.buffer_count), lr=self.lr_default,
             betas=betas, eps=eps, weight_decay=wd, adamw_mode=adamw,
             name="optimizer")
+        self.opt.store.io_policy = self._io_policy
         self._aio = shared_aio
 
         # collect-mode gradient accumulator, allocated lazily (fp32 [L, n])
@@ -1013,6 +1047,26 @@ class InfinityStepper:
             sq += block_sq
             gnorm = math.sqrt(sq) / gas
             if self.clip > 0.0:
+                if not np.isfinite(gnorm) and self._skip_nonfinite:
+                    # clip-gated mode is the one Infinity mode where the
+                    # sweep has NOT run yet when the norm is known — a
+                    # poisoned step can still be skipped outright
+                    # (resilience.skip_nonfinite_grad_steps)
+                    logger.warning(
+                        f"non-finite global grad norm ({gnorm}) — skipping "
+                        f"the optimizer sweep for this step")
+                    self.opt.step_count -= 1   # undo begin_step
+                    self._grad_accum[:] = 0.0
+                    engine.state["skipped"] = engine.state["skipped"] + 1
+                    self._dev.clear()
+                    self._sweep_uploads(block=True)
+                    self.param_store.flush()
+                    self.opt.flush()
+                    metrics = {"loss": loss_total / gas, "grad_norm": gnorm,
+                               "lr": lr, "overflow": 1, "loss_scale": 1.0,
+                               "step_time": time.perf_counter() - t0}
+                    self._last_metrics = metrics
+                    return metrics
                 if np.isfinite(gnorm) and gnorm > self.clip:
                     grad_scale *= gnorm / self.clip
                 # clip-gated sweep, parallel across layers/cores
@@ -1102,12 +1156,12 @@ class InfinityStepper:
             # logical (unpadded) vectors — checkpoints are mesh-independent,
             # a D=1 save restores onto a D=8 mesh and vice versa
             n = self.n_elems
-            np.savez(os.path.join(path, f"slot_{i:05d}.npz"),
-                     p=p[:n], m=m[:n], v=v[:n])
+            _savez_retry(os.path.join(path, f"slot_{i:05d}.npz"),
+                         self._io_policy, p=p[:n], m=m[:n], v=v[:n])
         res = self._resident_state_host()
-        np.savez(os.path.join(path, "resident.npz"),
-                 **{f"{k}_{j}": a for k, arrs in res.items()
-                    for j, a in enumerate(arrs)})
+        _savez_retry(os.path.join(path, "resident.npz"), self._io_policy,
+                     **{f"{k}_{j}": a for k, arrs in res.items()
+                        for j, a in enumerate(arrs)})
 
         def path_str(p):
             return "/".join(str(getattr(x, "key", x)) for x in p)
@@ -1170,7 +1224,8 @@ class InfinityStepper:
                 f"does not match this model (L={self.L}, n={self.n_elems})")
         zl = np.zeros(self.n_local, np.float32)
         for i in range(self.L):
-            with np.load(os.path.join(path, f"slot_{i:05d}.npz")) as z:
+            with _load_npz_retry(os.path.join(path, f"slot_{i:05d}.npz"),
+                                 self._io_policy) as z:
                 p = self._local_f32(z["p"])
                 m = self._local_f32(z["m"]) if load_optimizer_states else zl
                 v = self._local_f32(z["v"]) if load_optimizer_states else zl
@@ -1179,7 +1234,8 @@ class InfinityStepper:
                 buf[:self.n_local * 2].view(np.uint16)[:] = (
                     p.astype(ml_dtypes.bfloat16).view(np.uint16))
                 self.param_store.release(i, dirty=True)
-        with np.load(os.path.join(path, "resident.npz")) as z:
+        with _load_npz_retry(os.path.join(path, "resident.npz"),
+                             self._io_policy) as z:
             n = meta["n_res_leaves"]
             res = {k: [z[f"{k}_{j}"] for j in range(n)]
                    for k in ("master", "m", "v")}

@@ -24,6 +24,9 @@ for f in tests/unit/test_*.py; do
   if [[ -n "$FILTER" && "$f" != *"$FILTER"* ]]; then
     continue
   fi
+  if [[ "$f" == *test_resilience.py ]]; then
+    continue   # runs once in the marker sweep below, not twice
+  fi
   echo "=== $f"
   if python -m pytest "$f" -q --tb=short ${EXTRA_PYTEST_ARGS:-}; then
     PASSED=$((PASSED + 1))
@@ -31,6 +34,20 @@ for f in tests/unit/test_*.py; do
     FAILED+=("$f")
   fi
 done
+
+# Resilience / fault-injection sweep: the `resilience`-marked tests
+# (pytest.ini) must pass standalone under forced-CPU with no real TPU —
+# the failure paths (torn checkpoints, transient I/O, hung workers) are
+# only trustworthy if they run in CI, not just when something breaks.
+if [[ -z "$FILTER" || "resilience" == *"$FILTER"* ]]; then
+  echo "=== resilience marker sweep (pytest -m resilience)"
+  if JAX_PLATFORMS=cpu python -m pytest tests/unit/test_resilience.py \
+       -m resilience -q --tb=short ${EXTRA_PYTEST_ARGS:-}; then
+    PASSED=$((PASSED + 1))
+  else
+    FAILED+=("pytest -m resilience")
+  fi
+fi
 
 echo
 echo "=== suite: $PASSED module(s) green, ${#FAILED[@]} failed" \

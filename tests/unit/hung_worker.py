@@ -1,0 +1,42 @@
+"""Hung-worker stub for the elastic-agent watchdog tests.
+
+Worker side of the liveness contract (elasticity/elastic_agent.py +
+runtime/resilience/heartbeat.py): touch the file named by
+DSTPU_HEARTBEAT_FILE on the training cadence. The designated
+(rank, generation) instead goes silent while staying alive — the exact
+failure poll() cannot see and the watchdog must.
+
+Plain file touches rather than resilience.Heartbeat: importing the
+package pulls in jax, and this stub is forked once per worker per
+generation — keeping it dependency-free keeps the test fast.
+
+Env: RANK, ELASTIC_RESTART_COUNT, DSTPU_HEARTBEAT_FILE (optional),
+DSTPU_HANG_RANK, DSTPU_HANG_GEN, DSTPU_WORK_S (healthy-worker runtime).
+"""
+import os
+import sys
+import time
+
+
+def main():
+    rank = int(os.environ.get("RANK", "0"))
+    gen = int(os.environ.get("ELASTIC_RESTART_COUNT", "0"))
+    hb = os.environ.get("DSTPU_HEARTBEAT_FILE")
+    hang_rank = int(os.environ.get("DSTPU_HANG_RANK", "-1"))
+    hang_gen = int(os.environ.get("DSTPU_HANG_GEN", "-1"))
+    if rank == hang_rank and gen == hang_gen:
+        # hung: the process stays alive but never heartbeats again
+        time.sleep(600)
+        sys.exit(0)
+    deadline = time.time() + float(os.environ.get("DSTPU_WORK_S", "0.8"))
+    while time.time() < deadline:
+        if hb:
+            with open(hb, "a"):
+                pass
+            os.utime(hb, None)
+        time.sleep(0.1)
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

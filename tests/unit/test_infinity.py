@@ -931,7 +931,9 @@ class TestInfinityMultiChip:
                              jax.random.PRNGKey(0))
         st = e._infinity
         assert st.dp == 8 and st.n_pad % 8 == 0
-        arr = st._ensure_layer(0, {0})
+        # _ensure_layer returns a tuple of device arrays — (bf16 flat,)
+        # uncompressed, (payload, scales) under the quantized param wire
+        arr = st._ensure_layer(0, {0})[0]
         assert arr.addressable_shards[0].data.shape == (st.n_pad // 8,)
         assert len({s.device for s in arr.addressable_shards}) == 8
         st._sweep_uploads(block=True)

@@ -1,0 +1,590 @@
+"""Fault-tolerance layer (runtime/resilience): retry/backoff, fault
+injection, checkpoint integrity + last-good fallback, non-finite-grad
+skip-step, the elastic-agent watchdog, and the inference sync guard.
+
+The discipline here mirrors the reference's checkpoint/elasticity suites
+but aims at the FAILURE paths: every behavior asserted below is driven
+by a deterministic injected fault (no flaky timing, no real broken
+hardware needed) and runs under the forced-CPU harness.
+"""
+import errno
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import TransformerLM, gpt2_config
+from deepspeed_tpu.runtime.resilience import (
+    CheckpointCorruptionError, FatalIOError, FaultInjector, Heartbeat,
+    RetryPolicy, TransientIOError, Watchdog, atomic_write_text, beat,
+    heartbeat_age, install_fault_injector, is_stale, is_transient,
+    retry_call, run_with_timeout, verify_manifest, write_manifest)
+
+pytestmark = pytest.mark.resilience
+
+FAST = RetryPolicy(max_attempts=3, base_delay_s=0.0, max_delay_s=0.0,
+                   jitter=0.0)
+
+
+@pytest.fixture
+def injector():
+    """A fresh process-global FaultInjector per test."""
+    fi = install_fault_injector(FaultInjector())
+    yield fi
+    install_fault_injector(FaultInjector())
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+class TestRetryPolicy:
+    def test_fails_n_minus_1_times_then_succeeds(self):
+        calls = {"n": 0}
+        sleeps = []
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise TransientIOError("blip")
+            return 42
+
+        assert retry_call(flaky, policy=FAST, sleep=sleeps.append) == 42
+        assert calls["n"] == 3
+        assert len(sleeps) == 2
+
+    def test_fatal_error_not_retried(self):
+        calls = {"n": 0}
+
+        def fatal():
+            calls["n"] += 1
+            raise FatalIOError("gone")
+
+        with pytest.raises(FatalIOError):
+            retry_call(fatal, policy=FAST, sleep=lambda _: None)
+        assert calls["n"] == 1
+
+    def test_budget_exhausted_reraises_last_transient(self):
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise TransientIOError(f"blip {calls['n']}")
+
+        with pytest.raises(TransientIOError, match="blip 3"):
+            retry_call(always, policy=FAST, sleep=lambda _: None)
+        assert calls["n"] == 3
+
+    def test_oserror_errno_classification(self):
+        assert is_transient(OSError(errno.EIO, "io"))
+        assert is_transient(OSError(errno.EAGAIN, "again"))
+        assert not is_transient(OSError(errno.ENOENT, "missing"))
+        assert not is_transient(OSError(errno.ENOSPC, "full"))
+        assert not is_transient(ValueError("not io at all"))
+
+    def test_backoff_schedule(self):
+        p = RetryPolicy(max_attempts=5, base_delay_s=0.1, max_delay_s=0.3,
+                        multiplier=2.0, jitter=0.0)
+        assert [round(p.delay(k), 6) for k in range(4)] == \
+            [0.1, 0.2, 0.3, 0.3]
+        pj = RetryPolicy(base_delay_s=0.1, max_delay_s=0.1, jitter=0.5)
+        for _ in range(50):
+            assert 0.05 <= pj.delay(0) <= 0.15
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError):
+            RetryPolicy(base_delay_s=1.0, max_delay_s=0.5)
+        with pytest.raises(ValueError):
+            RetryPolicy(jitter=1.5)
+
+
+# ---------------------------------------------------------------------------
+# fault injector
+# ---------------------------------------------------------------------------
+class TestFaultInjector:
+    def test_fail_nth_call_deterministically(self, injector):
+        injector.add_plan("x.y", "fail", at=2)
+        injector.check("x.y")                       # call 1: clean
+        with pytest.raises(TransientIOError):
+            injector.check("x.y")                   # call 2: fires
+        injector.check("x.y")                       # call 3: clean again
+        assert injector.fire_count("x.y") == 1
+
+    def test_count_window_and_forever(self, injector):
+        injector.add_plan("a", "fail", at=1, count=2)
+        for _ in range(2):
+            with pytest.raises(TransientIOError):
+                injector.check("a")
+        injector.check("a")
+        injector.add_plan("b", "fail", at=3, count=-1)
+        injector.check("b")
+        injector.check("b")
+        for _ in range(4):
+            with pytest.raises(TransientIOError):
+                injector.check("b")
+
+    def test_truncate_and_delay(self, injector, tmp_path):
+        f = tmp_path / "victim.bin"
+        f.write_bytes(b"0123456789")
+        injector.add_plan("t", "truncate", at=1, arg=3)
+        injector.check("t", path=str(f))
+        assert f.read_bytes() == b"012"
+        injector.add_plan("d", "delay", at=1, arg=0.05)
+        t0 = time.monotonic()
+        injector.check("d")
+        assert time.monotonic() - t0 >= 0.04
+
+    def test_env_grammar(self):
+        fi = FaultInjector.from_env(
+            {"DSTPU_FAULTS":
+             "infinity.slot_write=fail:2:2;slot_store.read=fatal:1"})
+        assert fi.plans["infinity.slot_write"].at == 2
+        assert fi.plans["infinity.slot_write"].count == 2
+        assert fi.plans["slot_store.read"].kind == "fatal"
+        with pytest.raises(ValueError):
+            FaultInjector.from_env({"DSTPU_FAULTS": "nonsense"})
+
+    def test_config_driven_plans(self, injector):
+        injector.add_plans_from_config(
+            {"s": {"kind": "fatal", "at": 1}})
+        with pytest.raises(FatalIOError):
+            injector.check("s")
+
+
+# ---------------------------------------------------------------------------
+# integrity primitives
+# ---------------------------------------------------------------------------
+class TestIntegrity:
+    def test_atomic_write_leaves_no_tmp(self, tmp_path):
+        p = tmp_path / "latest"
+        atomic_write_text(str(p), "tag_a")
+        atomic_write_text(str(p), "tag_b")
+        assert p.read_text() == "tag_b"
+        assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+
+    def test_manifest_roundtrip_and_corruption(self, tmp_path, injector):
+        d = tmp_path / "tag"
+        sub = d / "state"
+        sub.mkdir(parents=True)
+        (d / "meta.json").write_text("{}")
+        (sub / "shard0.bin").write_bytes(os.urandom(4096))
+        write_manifest(str(d))
+        ok, problems = verify_manifest(str(d))
+        assert ok and problems == []
+        # torn write: truncate one artifact
+        FaultInjector.truncate_file(str(sub / "shard0.bin"), 100)
+        ok, problems = verify_manifest(str(d))
+        assert not ok and any("truncated" in p for p in problems)
+        # bit-rot at same size
+        raw = bytearray((sub / "shard0.bin").read_bytes())
+        (sub / "shard0.bin").write_bytes(os.urandom(len(raw)))
+        ok, problems = verify_manifest(str(d))
+        assert not ok
+        # missing artifact
+        os.remove(sub / "shard0.bin")
+        ok, problems = verify_manifest(str(d))
+        assert not ok and any("missing" in p for p in problems)
+
+    def test_manifestless_dir_fails_verification(self, tmp_path):
+        ok, problems = verify_manifest(str(tmp_path))
+        assert not ok and any("manifest" in p for p in problems)
+
+    def test_malformed_manifest_entries_report_not_crash(self, tmp_path):
+        """JSON-valid bit-rot inside the manifest must engage the
+        fallback path, not raise KeyError out of the verifier."""
+        import json
+        (tmp_path / "a.bin").write_bytes(b"abc")
+        (tmp_path / "manifest.json").write_text(json.dumps(
+            {"version": 1, "files": {"a.bin": {"crc32": 1},   # no size
+                                     "b.bin": "not-a-dict"}}))
+        ok, problems = verify_manifest(str(tmp_path))
+        assert not ok and len(problems) == 2
+        assert all("malformed" in p for p in problems)
+        (tmp_path / "manifest.json").write_text(
+            json.dumps({"version": 1, "files": [1, 2]}))
+        ok, problems = verify_manifest(str(tmp_path))
+        assert not ok and "files" in problems[0]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint engine: corrupt-tag fallback
+# ---------------------------------------------------------------------------
+def tiny_model():
+    cfg = gpt2_config("125m", num_layers=2, d_model=32, num_heads=4,
+                      vocab_size=64, max_seq_len=16, dtype=jnp.float32)
+    return TransformerLM(cfg)
+
+
+def make_engine(resilience=None):
+    config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+        "mesh": {"data": 8},
+    }
+    if resilience:
+        config["resilience"] = resilience
+    engine, _, _, _ = ds.initialize(model=tiny_model(), config=config,
+                                    rng=jax.random.PRNGKey(7))
+    return engine
+
+
+def batch(seed=0):
+    rs = np.random.RandomState(seed)
+    return {"input_ids": rs.randint(0, 64, (8, 16), dtype=np.int32)}
+
+
+def _largest_artifact(tag_dir):
+    """Path + recorded entry of the biggest file in the tag's manifest."""
+    import json
+    with open(os.path.join(tag_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    rel = max(manifest["files"], key=lambda r: manifest["files"][r]["size"])
+    return os.path.join(tag_dir, rel), manifest["files"][rel]
+
+
+@pytest.fixture(scope="module")
+def eng():
+    """One shared engine for the save/load/skip tests (engine builds
+    dominate this module's runtime; every test below asserts relative to
+    the state it finds, so sharing is safe)."""
+    return make_engine()
+
+
+class TestCheckpointIntegrity:
+    def test_save_writes_verified_manifest(self, tmp_path, eng):
+        eng.train_step(batch(0))
+        eng.save_checkpoint(str(tmp_path), tag="t1")
+        tag_dir = tmp_path / "t1"
+        assert (tag_dir / "manifest.json").exists()
+        ok, problems = verify_manifest(str(tag_dir))
+        assert ok, problems
+        # no torn temp files anywhere in the tree
+        for root, _dirs, files in os.walk(tmp_path):
+            assert not [f for f in files if ".tmp." in f]
+
+    def test_truncated_shard_falls_back_to_prior_tag(self, tmp_path, eng):
+        """The acceptance scenario: a shard torn mid-write is detected at
+        load and the engine lands on the newest VERIFIED tag."""
+        eng.train_step(batch(0))
+        steps_t1 = int(eng.state["step"])
+        eng.save_checkpoint(str(tmp_path), tag="t1")
+        eng.train_step(batch(1))
+        eng.train_step(batch(2))
+        eng.save_checkpoint(str(tmp_path), tag="t2")   # latest -> t2
+
+        shard, entry = _largest_artifact(str(tmp_path / "t2"))
+        FaultInjector.truncate_file(shard, entry["size"] // 2)
+
+        path, _client = eng.load_checkpoint(str(tmp_path))
+        assert path is not None and path.endswith("t1")
+        assert int(eng.state["step"]) == steps_t1
+        assert eng.global_steps == steps_t1
+
+    def test_explicitly_named_corrupt_tag_raises(self, tmp_path, eng):
+        eng.save_checkpoint(str(tmp_path), tag="t1")
+        eng.save_checkpoint(str(tmp_path), tag="t2")
+        shard, entry = _largest_artifact(str(tmp_path / "t2"))
+        FaultInjector.truncate_file(shard, 0)
+        with pytest.raises(CheckpointCorruptionError):
+            eng.load_checkpoint(str(tmp_path), tag="t2")
+
+    def test_dangling_latest_falls_back(self, tmp_path, eng):
+        """'latest' naming a deleted tag dir is one more corruption
+        shape: the load must reach the same last-good fallback."""
+        import shutil
+        eng.save_checkpoint(str(tmp_path), tag="t1")
+        eng.save_checkpoint(str(tmp_path), tag="t2")
+        shutil.rmtree(tmp_path / "t2")      # latest now dangles
+        path, _ = eng.load_checkpoint(str(tmp_path))
+        assert path.endswith("t1")
+
+    def test_corruption_with_no_fallback_raises(self, tmp_path, eng):
+        eng.save_checkpoint(str(tmp_path), tag="only")
+        shard, entry = _largest_artifact(str(tmp_path / "only"))
+        FaultInjector.truncate_file(shard, 1)
+        with pytest.raises(CheckpointCorruptionError):
+            eng.load_checkpoint(str(tmp_path))
+
+    def test_failed_publish_keeps_previous_latest(self, tmp_path,
+                                                  injector, eng):
+        """A crash during commit must leave the previous checkpoint the
+        loadable one — 'latest' moves last."""
+        eng.save_checkpoint(str(tmp_path), tag="good")
+        injector.add_plan("checkpoint.publish", "fatal", at=1)
+        eng.train_step(batch(1))
+        with pytest.raises(FatalIOError):
+            eng.save_checkpoint(str(tmp_path), tag="bad")
+        assert (tmp_path / "latest").read_text().strip() == "good"
+        path, _ = eng.load_checkpoint(str(tmp_path))
+        assert path.endswith("good")
+
+    def test_transient_publish_fault_retried(self, tmp_path, injector,
+                                             eng):
+        injector.add_plan("checkpoint.publish", "fail", at=1)
+        eng.save_checkpoint(str(tmp_path), tag="t1")   # retry absorbs it
+        assert injector.fire_count("checkpoint.publish") == 1
+        assert (tmp_path / "latest").read_text().strip() == "t1"
+
+    def test_integrity_disabled_skips_manifest(self, tmp_path):
+        e = make_engine(resilience={"checkpoint_integrity": False,
+                                    "verify_on_save": False})
+        e.train_step(batch(0))
+        e.save_checkpoint(str(tmp_path), tag="t1")
+        assert not (tmp_path / "t1" / "manifest.json").exists()
+        path, _ = e.load_checkpoint(str(tmp_path))
+        assert path.endswith("t1")
+
+
+# ---------------------------------------------------------------------------
+# retriable slot I/O (infinity stream + NVMe slot store)
+# ---------------------------------------------------------------------------
+class TestSlotIORetry:
+    def test_infinity_slot_write_retries_without_data_loss(
+            self, tmp_path, injector):
+        """Acceptance scenario: a transient fault on an infinity slot
+        write succeeds after retries, data intact."""
+        from deepspeed_tpu.runtime.zero.infinity import (_load_npz_retry,
+                                                         _savez_retry)
+        injector.add_plan("infinity.slot_write", "fail", at=1, count=2)
+        path = str(tmp_path / "slot_00000.npz")
+        p = np.arange(64, dtype=np.float32)
+        m = np.ones(64, np.float32)
+        _savez_retry(path, FAST, p=p, m=m)
+        assert injector.fire_count("infinity.slot_write") == 2
+        with _load_npz_retry(path, FAST) as z:
+            np.testing.assert_array_equal(z["p"], p)
+            np.testing.assert_array_equal(z["m"], m)
+
+    def test_infinity_slot_fatal_not_retried(self, tmp_path, injector):
+        from deepspeed_tpu.runtime.zero.infinity import _savez_retry
+        injector.add_plan("infinity.slot_write", "fatal", at=1)
+        with pytest.raises(FatalIOError):
+            _savez_retry(str(tmp_path / "s.npz"), FAST,
+                         p=np.zeros(4, np.float32))
+        assert injector.fire_count("infinity.slot_write") == 1
+
+    def test_nvme_store_write_retries_without_data_loss(
+            self, tmp_path, injector):
+        from deepspeed_tpu.runtime.swap_tensor.slot_store import \
+            NvmeSlotStore
+        injector.add_plan("slot_store.write", "fail", at=1)
+        st = NvmeSlotStore(4, 512, str(tmp_path / "s.swp"),
+                           buffer_count=2)
+        st.io_policy = FAST
+        try:
+            data = np.arange(512, dtype=np.uint8)
+            st.write_slot(1, data)          # first pwrite submit fails
+            st.flush()
+            # cycle the 2-buffer ring so slot 1 must re-read from disk
+            st.write_slot(0, np.zeros(512, np.uint8))
+            st.write_slot(2, np.zeros(512, np.uint8))
+            st.flush()
+            np.testing.assert_array_equal(st.read_slot(1, 512), data)
+            assert injector.fire_count("slot_store.write") == 1
+        finally:
+            st.close()
+
+    def test_nvme_store_read_retries(self, tmp_path, injector):
+        from deepspeed_tpu.runtime.swap_tensor.slot_store import \
+            NvmeSlotStore
+        st = NvmeSlotStore(3, 256, str(tmp_path / "r.swp"),
+                           buffer_count=2)
+        st.io_policy = FAST
+        try:
+            data = np.arange(256, dtype=np.uint8)[::-1].copy()
+            st.write_slot(0, data)
+            st.flush()
+            st.write_slot(1, np.zeros(256, np.uint8))
+            st.write_slot(2, np.zeros(256, np.uint8))
+            st.flush()
+            injector.add_plan("slot_store.read", "fail", at=1)
+            np.testing.assert_array_equal(st.read_slot(0, 256), data)
+            assert injector.fire_count("slot_store.read") == 1
+        finally:
+            st.close()
+
+
+# ---------------------------------------------------------------------------
+# engine hygiene: non-finite grad norm skips the step
+# ---------------------------------------------------------------------------
+class TestNonFiniteSkipStep:
+    def test_nan_grads_skip_update_and_count(self, eng):
+        e = eng
+        step0, skipped0 = int(e.state["step"]), int(e.state["skipped"])
+        before = [np.asarray(x).copy()
+                  for x in jax.tree_util.tree_leaves(e.state["params"])]
+        e._grad_acc = jax.tree_util.tree_map(
+            lambda p: jnp.full(p.shape, jnp.nan, jnp.float32),
+            e.state["params"])
+        e._grad_acc_count = 1
+        e.step()
+        assert int(e.state["skipped"]) == skipped0 + 1
+        assert int(e.state["step"]) == step0      # update skipped
+        after = jax.tree_util.tree_leaves(e.state["params"])
+        for b, a in zip(before, after):
+            np.testing.assert_array_equal(b, np.asarray(a))
+        # a healthy step afterwards still works and advances
+        e.train_step(batch(3))
+        assert int(e.state["step"]) == step0 + 1
+        assert np.isfinite(float(e.get_global_grad_norm()))
+
+    def test_opt_out_via_config(self):
+        e = make_engine(resilience={"skip_nonfinite_grad_steps": False})
+        e._grad_acc = jax.tree_util.tree_map(
+            lambda p: jnp.full(p.shape, jnp.nan, jnp.float32),
+            e.state["params"])
+        e._grad_acc_count = 1
+        e.step()
+        # without the hygiene (and no fp16 scaler) the poison goes through
+        assert int(e.state["skipped"]) == 0
+        assert int(e.state["step"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# liveness: heartbeat + elastic-agent watchdog
+# ---------------------------------------------------------------------------
+class TestHeartbeat:
+    def test_beat_and_staleness(self, tmp_path):
+        p = str(tmp_path / "hb")
+        assert heartbeat_age(p) == float("inf")
+        beat(p)
+        assert heartbeat_age(p) < 5.0
+        assert not is_stale(p, 5.0)
+        assert is_stale(p, -1.0)
+
+    def test_rate_limited_heartbeat(self, tmp_path):
+        p = str(tmp_path / "hb")
+        hb = Heartbeat(path=p, interval_s=10.0)
+        hb.maybe_beat()
+        t0 = os.path.getmtime(p)
+        time.sleep(0.05)
+        hb.maybe_beat()     # inside the interval: no touch
+        assert os.path.getmtime(p) == t0
+        assert Heartbeat(path=None).enabled is False
+
+    def test_watchdog_flags_stale(self, tmp_path):
+        a, b = str(tmp_path / "a"), str(tmp_path / "b")
+        beat(a)
+        wd = Watchdog(timeout_s=0.2)
+        assert wd.stale([a, b]) == [1]      # b never checked in
+        with pytest.raises(ValueError):
+            Watchdog(timeout_s=0.0)
+
+
+HUNG_WORKER = os.path.join(os.path.dirname(__file__), "hung_worker.py")
+
+
+def elastic_cfg():
+    return {"elasticity": {"enabled": True,
+                           "micro_batch_sizes": [1, 2, 3, 4],
+                           "max_acceptable_batch_size": 8,
+                           "min_gpus": 1, "max_gpus": 4,
+                           "version": 0.1}}
+
+
+class TestElasticWatchdog:
+    def test_hung_worker_triggers_rerendezvous(self, tmp_path):
+        """A worker that stays alive but stops heartbeating is killed by
+        the watchdog and the group re-rendezvouses at the shrunk world —
+        the failure poll() alone can never see."""
+        from deepspeed_tpu.elasticity.elastic_agent import (ElasticAgent,
+                                                            WorkerSpec)
+        spec = WorkerSpec(
+            argv=[sys.executable, HUNG_WORKER],
+            env={"DSTPU_HANG_RANK": "1", "DSTPU_HANG_GEN": "0",
+                 "DSTPU_WORK_S": "0.6"})
+        agent = ElasticAgent(spec, elastic_cfg(), initial_world_size=3,
+                             monitor_interval=0.05, max_restarts=3,
+                             watchdog_timeout=1.0,
+                             heartbeat_dir=str(tmp_path / "hb"))
+        res = agent.run()
+        assert res.success
+        assert res.generations == 2           # one re-rendezvous
+        assert res.final_world_size == 2      # shrunk from 3
+        assert res.failed_slots == 1
+
+    def test_watchdog_config_plumbed_from_resilience_block(self):
+        from deepspeed_tpu.elasticity.elastic_agent import (ElasticAgent,
+                                                            WorkerSpec)
+        cfg = elastic_cfg()
+        cfg["resilience"] = {"watchdog_timeout_s": 7.5}
+        agent = ElasticAgent(WorkerSpec(argv=["true"]), cfg,
+                             initial_world_size=2)
+        assert agent.watchdog_timeout == 7.5
+        # an explicit 0 must win over the config (0 means OFF, not unset)
+        agent = ElasticAgent(WorkerSpec(argv=["true"]), cfg,
+                             initial_world_size=2, watchdog_timeout=0.0)
+        assert agent.watchdog_timeout == 0.0
+
+    def test_engine_beats_heartbeat_on_train_step(self, tmp_path, eng):
+        """The engine is the worker side of the watchdog contract: with a
+        heartbeat file assigned, every train_step touches it."""
+        p = str(tmp_path / "hb")
+        eng._heartbeat = Heartbeat(path=p, interval_s=0.0)
+        eng.train_step(batch(5))
+        assert os.path.exists(p)
+
+
+# ---------------------------------------------------------------------------
+# satellites: comm backend validation, sync guard, config block
+# ---------------------------------------------------------------------------
+class TestSatellites:
+    def test_unknown_dist_backend_raises(self):
+        from deepspeed_tpu.comm import comm
+        with pytest.raises(ValueError, match="xla"):
+            comm.init_distributed(dist_backend="nccl")
+        with pytest.raises(ValueError, match="supported"):
+            comm.init_distributed(dist_backend="gloo")
+
+    def test_run_with_timeout(self):
+        assert run_with_timeout(lambda: None, 1.0) is True
+        assert run_with_timeout(lambda: time.sleep(3.0), 0.1) is False
+        with pytest.raises(RuntimeError, match="boom"):
+            run_with_timeout(
+                lambda: (_ for _ in ()).throw(RuntimeError("boom")),
+                1.0)
+
+    def test_inference_guarded_sync(self):
+        from types import SimpleNamespace
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        fake = SimpleNamespace(
+            config=SimpleNamespace(profile_sync_timeout_s=0.1))
+
+        class Wedged:
+            def block_until_ready(self):
+                time.sleep(2.0)
+
+        class Fast:
+            def block_until_ready(self):
+                pass
+
+        assert InferenceEngine._guarded_sync(fake, Fast()) is True
+        assert InferenceEngine._guarded_sync(fake, Wedged()) is False
+
+    def test_resilience_config_defaults_and_validation(self):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        cfg = DeepSpeedConfig({"train_batch_size": 8})
+        rz = cfg.resilience
+        assert rz.checkpoint_integrity and rz.fallback_to_last_good
+        assert rz.io_retry_attempts == 3
+        assert rz.skip_nonfinite_grad_steps
+        assert rz.watchdog_timeout_s == 0.0
+        with pytest.raises(ValueError):
+            DeepSpeedConfig({"train_batch_size": 8,
+                             "resilience": {"io_retry_attempts": 0}})
+        with pytest.raises(ValueError):
+            DeepSpeedConfig({"train_batch_size": 8,
+                             "resilience": {"io_retry_jitter": 2.0}})
+        with pytest.raises(ValueError):
+            # watchdog tighter than two heartbeats kills healthy workers
+            DeepSpeedConfig({"train_batch_size": 8,
+                             "resilience": {"watchdog_timeout_s": 1.0,
+                                            "heartbeat_interval_s": 0.9}})
