@@ -403,12 +403,37 @@ class DeepSpeedConfig:
     # -- parsing ----------------------------------------------------------
     def _initialize_params(self, pd: dict) -> None:
         g = pd.get
-        self.train_batch_size = g(C.TRAIN_BATCH_SIZE)
-        self.train_micro_batch_size_per_gpu = g(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU)
-        self.gradient_accumulation_steps = g(C.GRADIENT_ACCUMULATION_STEPS)
+        self.train_batch_size = g(C.TRAIN_BATCH_SIZE,
+                                  C.TRAIN_BATCH_SIZE_DEFAULT)
+        self.train_micro_batch_size_per_gpu = g(
+            C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+            C.TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+        self.gradient_accumulation_steps = g(
+            C.GRADIENT_ACCUMULATION_STEPS,
+            C.GRADIENT_ACCUMULATION_STEPS_DEFAULT)
         self.steps_per_print = g(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
         self.dump_state = g(C.DUMP_STATE, False)
         self.gradient_clipping = g(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
+        # legacy DeepSpeed alias: top-level max_grad_norm == gradient_clipping
+        # (previously accepted and silently IGNORED — dstpu-lint CFG001)
+        mgn = g(C.MAX_GRAD_NORM)
+        if mgn is not None:
+            if C.GRADIENT_CLIPPING in pd and pd[C.GRADIENT_CLIPPING] != mgn:
+                raise ValueError(
+                    f"both {C.GRADIENT_CLIPPING} "
+                    f"({pd[C.GRADIENT_CLIPPING]}) and its legacy alias "
+                    f"{C.MAX_GRAD_NORM} ({mgn}) are set and disagree")
+            self.gradient_clipping = mgn
+        # amp is apex/CUDA mixed precision; a config that asks for it must
+        # not silently train in fp32 (previously ignored — dstpu-lint CFG001)
+        amp = g(C.AMP) or {}
+        amp_on = (amp.get("enabled", False) if isinstance(amp, dict)
+                  else bool(amp))    # "amp": true shorthand
+        if amp_on:
+            raise NotImplementedError(
+                "amp (apex) is CUDA-specific and not supported on TPU — "
+                "use bf16: {enabled: true} (native) or fp16 with dynamic "
+                "loss scaling instead")
         self.prescale_gradients = g(C.PRESCALE_GRADIENTS, C.PRESCALE_GRADIENTS_DEFAULT)
         self.gradient_predivide_factor = g(C.GRADIENT_PREDIVIDE_FACTOR,
                                            C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
@@ -417,7 +442,7 @@ class DeepSpeedConfig:
                                       C.WALL_CLOCK_BREAKDOWN_DEFAULT)
         self.communication_data_type = g(C.COMMUNICATION_DATA_TYPE)
         self.disable_allgather = g(C.DISABLE_ALLGATHER, False)
-        self.memory_breakdown = g("memory_breakdown", False)
+        self.memory_breakdown = g(C.MEMORY_BREAKDOWN, False)
 
         self.fp16 = FP16Config(**g(C.FP16, {}))
         self.bf16 = BF16Config(**g(C.BF16, {}))
@@ -440,7 +465,7 @@ class DeepSpeedConfig:
             csv_monitor=CSVConfig(**g(C.MONITOR_CSV, {})),
         )
         self.checkpoint_config = CheckpointConfig(**g(C.CHECKPOINT, {}))
-        self.comms_config = CommsConfig(**g("comms_logger", {}))
+        self.comms_config = CommsConfig(**g(C.COMMS_LOGGER, {}))
         self.resilience = ResilienceConfig(**g(C.RESILIENCE, {}))
 
         # Late imports to avoid cycles; these blocks are parsed by their

@@ -35,6 +35,7 @@ ZERO_OPTIMIZATION = "zero_optimization"
 # Misc engine knobs
 #############################################
 GRADIENT_CLIPPING = "gradient_clipping"
+MEMORY_BREAKDOWN = "memory_breakdown"
 PRESCALE_GRADIENTS = "prescale_gradients"
 GRADIENT_PREDIVIDE_FACTOR = "gradient_predivide_factor"
 STEPS_PER_PRINT = "steps_per_print"
@@ -65,6 +66,7 @@ MESH = "mesh"
 CHECKPOINT = "checkpoint"
 TENSOR_PARALLEL = "tensor_parallel"
 RESILIENCE = "resilience"
+COMMS_LOGGER = "comms_logger"
 
 #############################################
 # Defaults

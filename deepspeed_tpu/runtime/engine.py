@@ -1026,10 +1026,17 @@ class DeepSpeedEngine:
         if self._grad_acc is None:
             self._grad_acc = grads
         else:
+            # cache the jitted adder: jax.jit keys its compile cache on
+            # the callable object, so a fresh lambda here meant a fresh
+            # trace+compile EVERY microbatch (dstpu-lint TRACE003)
+            if getattr(self, "_grad_acc_add_fn", None) is None:
+                with self.mesh:
+                    self._grad_acc_add_fn = jax.jit(
+                        lambda a, b: jax.tree_util.tree_map(jnp.add, a, b),
+                        donate_argnums=(0,))
             with self.mesh:
-                self._grad_acc = jax.jit(
-                    lambda a, b: jax.tree_util.tree_map(jnp.add, a, b),
-                    donate_argnums=(0,))(self._grad_acc, grads)
+                self._grad_acc = self._grad_acc_add_fn(self._grad_acc,
+                                                       grads)
         self._grad_acc_count += 1
         self.micro_steps += 1
 

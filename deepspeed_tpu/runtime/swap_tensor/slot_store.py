@@ -116,6 +116,13 @@ class NvmeSlotStore(SlotStore):
     #: an acquire/release imbalance (instance-settable for tests)
     PIN_WAIT_TIMEOUT = 60.0
 
+    #: seconds close() waits for outstanding pins to drain before the
+    #: dangling-pin warning — sized to the transient window it guards
+    #: (a peer parked in the I/O retry backoff, bounded by the retry
+    #: budget: ~3s under the default policy), NOT the full acquire
+    #: budget, so teardown during exception cleanup stays fast
+    CLOSE_PIN_WAIT_TIMEOUT = 3.0
+
     #: optional callable the store invokes (lock held, re-entrant) when no
     #: buffer is free — lets the OWNER of outstanding pins release the ones
     #: whose async consumer (e.g. an H2D transfer) has finished. Without
@@ -283,17 +290,51 @@ class NvmeSlotStore(SlotStore):
             # buffer stays mapped (clean cache) until the ring reclaims it
 
     def flush(self) -> None:
-        self.aio.wait()
+        # wait + clear under ONE critical section: with the wait outside
+        # the lock, a concurrent release() could submit a writeback
+        # between the wait and the clear — flush would then None out an
+        # op id that was never waited on, and _free_buf could recycle
+        # that buffer while its write is still in flight (dstpu-lint
+        # LOCK001 caught the split). Ops already submitted complete
+        # independently of this lock, so holding it across the wait
+        # cannot deadlock.
         with self._lock:
+            self.aio.wait()
             self._buf_op = [None] * len(self._bufs)
 
     def close(self) -> None:
-        self.flush()
-        if self._own_aio:
-            self.aio.close()
-        for b in self._bufs:
-            b.free()
-        self._bufs = []
+        with self._lock:
+            # Teardown is ONE critical section (the RLock lets flush()
+            # nest inside it): a separately-locked flush would leave an
+            # unlock window where a racing release() submits a fresh
+            # writeback and b.free() hands the native IO thread freed
+            # memory. Before draining, WAIT (bounded) for outstanding
+            # pins: a peer parked in the retry backoff (cond.wait drops
+            # the lock mid-submission) still owns its buffer and will
+            # resubmit into it on wake — freeing under it would be a
+            # use-after-free. Its release notifies the condition. A pin
+            # that never drains is an acquire/release imbalance; close
+            # stays a forgiving teardown API (it may run during
+            # exception cleanup) and proceeds with a loud warning.
+            deadline = time.monotonic() + self.CLOSE_PIN_WAIT_TIMEOUT
+            while any(p > 0 for p in self._buf_pins):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    logger.warning(
+                        f"NvmeSlotStore.close with "
+                        f"{sum(1 for p in self._buf_pins if p > 0)} "
+                        f"buffer(s) still acquired after "
+                        f"{self.CLOSE_PIN_WAIT_TIMEOUT:.0f}s — "
+                        f"acquire/release imbalance; outstanding views "
+                        f"dangle after free")
+                    break
+                self._cond.wait(min(remaining, 1.0))
+            self.flush()
+            if self._own_aio:
+                self.aio.close()
+            for b in self._bufs:
+                b.free()
+            self._bufs = []
 
     @property
     def host_bytes(self) -> int:

@@ -16,6 +16,26 @@ import numpy as np
 from ..utils.logging import logger
 
 
+def host_transfer(value, block: bool = False):
+    """THE deliberate device→host sync point.
+
+    Every blocking transfer on a hot path must either route through here
+    or carry a ``# dstpu: ignore[SYNC00x]`` marker — ``dstpu-lint``
+    (tools/lint, SYNC family) flags bare ``np.asarray``/``device_get``/
+    ``block_until_ready`` reachable from jit/step paths, so accidental
+    syncs can't hide among deliberate ones (docs/lint.md).
+
+    ``block=False`` (default): materialize ``value`` on the host as a
+    numpy array. ``block=True``: wait for ``value``'s async computation
+    /transfer to complete and return it unchanged (the
+    ``block_until_ready`` form — e.g. joining an H2D upload before
+    recycling its pinned source buffer).
+    """
+    if block:
+        return jax.block_until_ready(value)
+    return np.asarray(value)
+
+
 def partition_uniform(num_items: int, num_parts: int) -> List[int]:
     """Boundaries [p0..pN] splitting num_items as evenly as possible.
     Reference `runtime/utils.py:573`."""

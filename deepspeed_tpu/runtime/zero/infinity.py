@@ -78,6 +78,7 @@ import numpy as np
 
 from ...utils.logging import logger
 from ..resilience import get_fault_injector, policy_from_config, retry_call
+from ..utils import host_transfer
 from . import wire_codec
 
 
@@ -266,6 +267,7 @@ class InfinityStepper:
         self._res_treedef = jax.tree_util.tree_structure(self.resident)
         self._res_optim = engine.optimizer
         with self.engine.mesh:
+            # dstpu: ignore[TRACE003] -- one compile at init, not per step
             self.res_state = jax.jit(self._res_optim.init)(self.resident)
 
         # -- compiled programs (built lazily per batch-key signature) ------
@@ -380,6 +382,7 @@ class InfinityStepper:
         device→host fetch, which dominates startup on slow D2H links."""
         model = self.model
         with self.engine.mesh:
+            # dstpu: ignore[TRACE003] -- one compile at init, not per step
             self.resident = jax.jit(model.init_resident,
                                     out_shardings=self._repl)(rng)
         if self.engine._config.zero_config.infinity_host_init:
@@ -478,7 +481,7 @@ class InfinityStepper:
         for slot, arrs, refs in self._pending_uploads:
             if block:
                 for a in arrs:
-                    jax.block_until_ready(a)
+                    host_transfer(a, block=True)  # join the H2D DMA
             if all(a.is_ready() for a in arrs):
                 if slot is not None:
                     self.param_store.release(slot, dirty=False)
@@ -512,26 +515,27 @@ class InfinityStepper:
     def _fetch_flat(self, arr: jax.Array) -> np.ndarray:
         """bf16 device vector → host, process-local span only (the D2H wire
         carries each host's partition, reference partitioned_param_swapper
-        per-rank IO)."""
+        per-rank IO). Deliberate sync — this IS the offload wire."""
         if jax.process_count() == 1:
-            return np.asarray(arr)
+            return host_transfer(arr)
         out = np.empty(self.n_local, ml_dtypes.bfloat16)
         for sh in arr.addressable_shards:
             sl = sh.index[0]
             lo = 0 if sl.start is None else int(sl.start)
             out[lo - self._lo:lo - self._lo + sh.data.shape[0]] = (
-                np.asarray(sh.data))
+                host_transfer(sh.data))
         return out
 
     def _fetch_span(self, arr: jax.Array) -> np.ndarray:
         """Process-local span of any P(data)-sharded 1-D vector (wire
-        payload / scales — lengths proportional to n_pad)."""
+        payload / scales — lengths proportional to n_pad). Deliberate
+        sync — the compressed-wire half of the offload stream."""
         if jax.process_count() == 1:
-            return np.asarray(arr)
+            return host_transfer(arr)
         shards = sorted(((0 if sh.index[0].start is None
                           else int(sh.index[0].start), sh.data)
                          for sh in arr.addressable_shards))
-        return np.concatenate([np.asarray(d) for _, d in shards])
+        return np.concatenate([host_transfer(d) for _, d in shards])
 
     def _decode_wire(self, wire, out: np.ndarray,
                      accumulate: bool) -> None:
@@ -753,7 +757,7 @@ class InfinityStepper:
     # micro fwd/bwd
     # ------------------------------------------------------------------
     def _prep_batch(self, batch):
-        ids = np.asarray(batch["input_ids"])
+        ids = np.asarray(batch["input_ids"])  # dstpu: ignore[SYNC003] -- host batch data
         gas = self.gas
         if ids.ndim == 2:
             b = ids.shape[0]
@@ -770,7 +774,7 @@ class InfinityStepper:
         tt = batch.get("token_type_ids")
 
         def reshape_like(a):
-            a = np.asarray(a)
+            a = np.asarray(a)  # dstpu: ignore[SYNC003] -- host batch data
             return (a.reshape(gas, a.shape[0] // gas, *a.shape[1:])
                     if a.ndim == 2 else a)
         return (ids,
@@ -802,7 +806,8 @@ class InfinityStepper:
         if not self.model.config.token_type_vocab:
             return jnp.zeros((1, 1), jnp.int32)
         if tt is None:
-            tt = np.zeros_like(np.asarray(ids))
+            tt = np.zeros_like(np.asarray(ids))  # dstpu: ignore[SYNC003] -- host batch data
+        # dstpu: ignore[SYNC003] -- host batch data, upload is async
         return jax.device_put(np.asarray(tt), self._batch_shard)
 
     def _micro_fwd_bwd(self, progs, ids, labels, mask, tt,
@@ -813,9 +818,12 @@ class InfinityStepper:
         PRE-quantization when the wire codec is active (the decoded norm
         is recomputed host-side in that case)."""
         zero_i = jnp.zeros((1, 1), jnp.int32)
+        # dstpu: ignore[SYNC003] -- host batch data, uploads are async
         ids_dev = jax.device_put(np.asarray(ids), self._batch_shard)
+        # dstpu: ignore[SYNC003] -- host batch data
         labels_dev = (jax.device_put(np.asarray(labels), self._batch_shard)
                       if labels is not None else zero_i)
+        # dstpu: ignore[SYNC003] -- host batch data
         mask_dev = (jax.device_put(np.asarray(mask, np.float32),
                                    self._batch_shard)
                     if mask is not None
@@ -954,7 +962,8 @@ class InfinityStepper:
         ids, labels, mask, tt = self._prep_batch(batch)
         progs = self._build_programs(labels is not None, mask is not None)
         step_i = int(engine.state["step"])
-        lr = float(engine.lr_schedule(jnp.asarray(step_i)))
+        # one deliberate sync: lr feeds the host Adam sweep's arguments
+        lr = float(host_transfer(engine.lr_schedule(jnp.asarray(step_i))))
         gas = self.gas
         # pure stream: grads are final on arrival, no norm gate — the Adam
         # sweep rides inside the backward with no accumulator at all
@@ -962,9 +971,7 @@ class InfinityStepper:
         self.opt.begin_step()
 
         futures = []
-        loss_total = 0.0
-        sq_total = 0.0
-        res_sq_total = 0.0
+        micro_stats: List[Tuple] = []   # (loss, res_sq, sq) device scalars
         res_acc = None
         self._dev.clear()
         if not pure_stream and self._grad_accum is None:
@@ -1002,9 +1009,12 @@ class InfinityStepper:
                 labels[j] if labels is not None else None,
                 mask[j] if mask is not None else None,
                 tt[j] if tt is not None else None, on_grad)
-            loss_total += float(loss)
-            res_sq_total += float(res_sq)
-            sq_total += float(sq)
+            # keep the per-microbatch scalars LAZY: float() here would
+            # block the stream thread on microbatch j's full backward
+            # before it may dispatch j+1 — gas-1 needless pipeline stalls
+            # per step (dstpu-lint SYNC002 caught it). Converted after
+            # the worker join below, when they are ready for free.
+            micro_stats.append((loss, res_sq, sq))
             res_acc = d_res if res_acc is None else self._res_add(res_acc,
                                                                  d_res)
         # Release every upload pin BEFORE blocking on the workers: once
@@ -1014,6 +1024,11 @@ class InfinityStepper:
         self._sweep_uploads(block=True)
         for f in futures:
             f.result()   # surface worker exceptions, join the sweep
+        loss_total = sum(float(host_transfer(ls)) for ls, _, _ in
+                         micro_stats)
+        res_sq_total = sum(float(host_transfer(rs)) for _, rs, _ in
+                           micro_stats)
+        sq_total = sum(float(host_transfer(s)) for _, _, s in micro_stats)
 
         grad_scale = float(gas)
         if pure_stream:
@@ -1036,7 +1051,7 @@ class InfinityStepper:
             # true norm — reference runtime/utils.py:325 clip_grad_norm_);
             # per-layer terms were recorded by _finish_layer as each
             # layer's accumulation completed
-            sq = float(self._res_sq(res_acc))
+            sq = float(host_transfer(self._res_sq(res_acc)))
             block_sq = float(np.sum(self._layer_sq))
             if jax.process_count() > 1:
                 # each host holds a disjoint span of the block grads —
@@ -1092,7 +1107,7 @@ class InfinityStepper:
         """Eval takes the batch whole (no gas split — eval batches need not
         match the training batch triple), streamed forward without an
         activation stash."""
-        ids = np.asarray(batch["input_ids"])
+        ids = np.asarray(batch["input_ids"])  # dstpu: ignore[SYNC003] -- host batch data
         labels = batch.get("labels")
         mask = batch.get("loss_mask")
         progs = self._build_programs(labels is not None, mask is not None)
@@ -1106,13 +1121,15 @@ class InfinityStepper:
         tt_dev = self._tt_dev(batch.get("token_type_ids"), ids)
         _, xL, aux = self._forward_stream(progs, ids_dev, tt_dev,
                                           stash=False)
-        out = float(progs["eval_loss"](
+        out = float(host_transfer(progs["eval_loss"](
             self.resident, xL, ids_dev,
+            # dstpu: ignore[SYNC003] -- host batch data
             jax.device_put(np.asarray(labels), self._batch_shard)
             if labels is not None else zero_i,
+            # dstpu: ignore[SYNC003] -- host batch data
             jax.device_put(np.asarray(mask, np.float32), self._batch_shard)
             if mask is not None
-            else jnp.zeros((1, 1), jnp.float32)))
+            else jnp.zeros((1, 1), jnp.float32))))
         if getattr(self.model.config, "moe_enabled", False):
             out += float(self.model.config.moe_aux_loss_coef * aux)
         self._sweep_uploads(block=True)

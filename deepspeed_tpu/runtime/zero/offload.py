@@ -31,6 +31,7 @@ import ml_dtypes
 import numpy as np
 
 from ...utils.logging import logger
+from ..utils import host_transfer
 
 
 class HostLossScaler:
@@ -169,7 +170,8 @@ class ZeroOffloadHostOptimizer:
                                             thread_name_prefix="offload-opt")
         if fetch_fn is None:
             def fetch_fn(k):
-                return np.asarray(grad_dev_leaves[k])                # D2H
+                # deliberate D2H — the grad leg of the offload wire
+                return host_transfer(grad_dev_leaves[k])
         prev: Optional[tuple] = None
         for idxs in buckets:
             ghosts = [fetch_fn(k) for k in idxs]

@@ -122,7 +122,10 @@ def encode_params_host(flat: np.ndarray, bits: int
     if n % CHUNK:
         raise ValueError(f"param wire needs n % {CHUNK} == 0, got {n}")
     levels = {8: 127.0, 4: 7.0}[bits]
-    x = np.asarray(flat, dtype=np.float32).reshape(-1, CHUNK)
+    # host-side cast of an already-host slot view (never a device array —
+    # device transfers route through runtime/utils.py host_transfer);
+    # copy=False keeps an f32 input zero-copy like np.asarray did
+    x = flat.astype(np.float32, copy=False).reshape(-1, CHUNK)
     amax = np.max(np.abs(x), axis=1)
     s = np.where(amax > 0, amax / levels, 1.0).astype(np.float32)
     # NaN/Inf chunks keep a NaN scale so a poisoned master poisons the

@@ -1,0 +1,2 @@
+"""Developer tooling that ships with the package (static analysis, CI
+helpers). Nothing under here is imported by the runtime."""

@@ -1,0 +1,26 @@
+"""dstpu-lint — AST-based TPU-hazard & concurrency static analyzer.
+
+The Python type system cannot enforce the discipline this framework's
+hot paths depend on: no silent device->host syncs inside the streamed
+train step, no tracer leaks or retrace bombs in jitted programs, no
+unlocked shared state in the threaded swap/offload stores, and a config
+schema whose constants and consumers stay in agreement. ``dstpu-lint``
+detects those hazard classes at lint time over the package's own source
+(stdlib ``ast`` only, no third-party deps) — see ``docs/lint.md`` for
+the rule catalog.
+
+Rule families:
+  SYNC  — host-sync hazards reachable from jit/step hot paths
+  TRACE — retrace / tracer-leak hazards inside jitted functions
+  LOCK  — threaded shared-state and lock-discipline hazards
+  CFG   — config-schema consistency (+ pytest-marker registration)
+
+Entry points: ``bin/dstpu-lint`` is the dependency-free CLI (it loads
+this package by path, skipping the jax import in the package root);
+``python -m deepspeed_tpu.tools.lint`` is an equivalent convenience
+that DOES import ``deepspeed_tpu`` (and therefore jax) on the way in —
+use the bin/ form in CI and jax-less environments.
+"""
+from .core import Finding, Severity, lint_paths  # noqa: F401
+from .baseline import Baseline  # noqa: F401
+from .cli import main  # noqa: F401

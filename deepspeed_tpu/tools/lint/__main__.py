@@ -1,0 +1,6 @@
+"""``python -m deepspeed_tpu.tools.lint`` — same entry as bin/dstpu-lint."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

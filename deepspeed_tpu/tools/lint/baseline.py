@@ -1,0 +1,76 @@
+"""Baseline: grandfather existing findings, fail only on regressions.
+
+The baseline file maps line-independent finding keys
+(``RULE:path:scope:detail`` — see ``Finding.key``) to an allowed count.
+CI compares the current run against it: a finding whose key has spare
+budget is *baselined* (reported, not failing); anything beyond the
+budget is *new* and fails the run. Fixing a finding and regenerating
+shrinks the file — the ratchet only tightens.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple
+
+from .core import Finding
+
+VERSION = 1
+
+
+class Baseline:
+    def __init__(self, counts: Dict[str, int]):
+        self.counts = dict(counts)
+
+    # -- io ----------------------------------------------------------------
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+        except FileNotFoundError:
+            return cls({})
+        if not isinstance(data, dict) or \
+                data.get("version") != VERSION or \
+                not isinstance(data.get("findings"), dict):
+            raise ValueError(
+                f"{path}: not a dstpu-lint baseline (expected "
+                f'{{"version": {VERSION}, "findings": {{...}}}})')
+        counts = {str(k): int(v) for k, v in data["findings"].items()}
+        return cls(counts)
+
+    def save(self, path: str) -> None:
+        payload = {
+            "version": VERSION,
+            "tool": "dstpu-lint",
+            "comment": "grandfathered findings — regenerate with "
+                       "`bin/dstpu-lint ... --write-baseline`; shrink "
+                       "it by fixing, never by hand-adding",
+            "findings": {k: self.counts[k]
+                         for k in sorted(self.counts)},
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2, sort_keys=False)
+            f.write("\n")
+
+    @classmethod
+    def from_findings(cls, findings: List[Finding]) -> "Baseline":
+        counts: Dict[str, int] = {}
+        for f in findings:
+            counts[f.key] = counts.get(f.key, 0) + 1
+        return cls(counts)
+
+    # -- comparison --------------------------------------------------------
+    def split(self, findings: List[Finding]
+              ) -> Tuple[List[Finding], List[Finding]]:
+        """(new, grandfathered) — deterministic: findings arrive sorted
+        by (path, line) and each key's budget absorbs the earliest."""
+        budget = dict(self.counts)
+        new: List[Finding] = []
+        old: List[Finding] = []
+        for f in findings:
+            if budget.get(f.key, 0) > 0:
+                budget[f.key] -= 1
+                old.append(f)
+            else:
+                new.append(f)
+        return new, old

@@ -1,0 +1,198 @@
+"""Linter core: findings, source model, suppression, orchestration.
+
+Everything here is stdlib-only (``ast`` + ``re``): the linter must run in
+CI before any heavyweight import (it never imports the code it lints).
+"""
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+
+class Severity:
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+
+
+#: trailing (or immediately-preceding) comment that silences a finding:
+#:   x = float(loss)   # dstpu: ignore[SYNC002] -- host metric, once a step
+#:   # dstpu: ignore           (blanket: silences every rule on the line)
+#: Parsed from real COMMENT tokens only (never string/docstring text),
+#: and bracketed rule ids must be valid (``SYNC002``) — a typo'd id
+#: suppresses nothing rather than degrading to a blanket ignore.
+_SUPPRESS_RE = re.compile(r"#\s*dstpu:\s*ignore(?P<bracket>\[[^\]]*\])?")
+_RULE_ID_RE = re.compile(r"^[A-Z]+[0-9]+$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str          # e.g. "SYNC002"
+    severity: str      # Severity.*
+    path: str          # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    scope: str = ""    # enclosing qualname, "" at module level
+    detail: str = ""   # stable discriminator for baseline keys
+
+    @property
+    def family(self) -> str:
+        return self.rule.rstrip("0123456789")
+
+    @property
+    def key(self) -> str:
+        """Line-independent identity used by the baseline: findings keep
+        matching their grandfathered entry when unrelated edits shift
+        line numbers."""
+        return f"{self.rule}:{self.path}:{self.scope}:{self.detail}"
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}:{self.col}"
+        scope = f" [{self.scope}]" if self.scope else ""
+        return f"{where}: {self.rule} {self.severity}: {self.message}{scope}"
+
+
+@dataclass
+class SourceModule:
+    """One parsed file plus the lookaside tables rules share."""
+    path: str              # absolute
+    rel: str               # repo-relative posix path (finding identity)
+    modname: str           # dotted module name relative to the lint root
+    text: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+    #: line -> set of silenced rule ids ("*" = all)
+    suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, path: str, root: str) -> "SourceModule":
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        modname = rel[:-3].replace("/", ".")
+        if modname.endswith(".__init__"):
+            modname = modname[: -len(".__init__")]
+        tree = ast.parse(text, filename=rel)
+        mod = cls(path=path, rel=rel, modname=modname, text=text, tree=tree,
+                  lines=text.splitlines())
+        mod._scan_suppressions()
+        return mod
+
+    def _scan_suppressions(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.text).readline)
+            comments = [(t.start[0], t.string) for t in tokens
+                        if t.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError):
+            comments = []
+        for lineno, text in comments:
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            bracket = m.group("bracket")
+            if bracket is None:
+                self.suppressions[lineno] = {"*"}
+                continue
+            ids = {r.strip() for r in bracket[1:-1].split(",") if r.strip()}
+            valid = {r for r in ids if _RULE_ID_RE.match(r)}
+            # a bracket full of typos suppresses NOTHING (empty set) —
+            # never silently widen to a blanket ignore
+            self.suppressions[lineno] = valid
+
+    def suppressed(self, finding: Finding) -> bool:
+        """A finding is silenced by a marker on its own line, or by a
+        standalone marker on the line directly above (for lines too long
+        to carry a trailing comment)."""
+        for ln in (finding.line, finding.line - 1):
+            ids = self.suppressions.get(ln)
+            if ids and ("*" in ids or finding.rule in ids):
+                # a marker on the PREVIOUS line only counts when that line
+                # is nothing but the marker comment
+                if ln == finding.line or \
+                        self.lines[ln - 1].lstrip().startswith("#"):
+                    return True
+        return False
+
+
+@dataclass
+class Project:
+    """All parsed modules plus the root they are relative to."""
+    root: str
+    modules: List[SourceModule]
+
+    def by_rel(self, suffix: str) -> Optional[SourceModule]:
+        """First module whose repo-relative path ends with ``suffix``."""
+        for m in self.modules:
+            if m.rel.endswith(suffix):
+                return m
+        return None
+
+
+_SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "build", "dist",
+              "node_modules", ".venv", "venv"}
+
+
+def collect_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(os.path.abspath(p))
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIRS
+                                 and not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.abspath(os.path.join(dirpath, fn)))
+    return out
+
+
+def load_project(paths: Sequence[str], root: Optional[str] = None,
+                 errors: Optional[List[str]] = None) -> Project:
+    root = os.path.abspath(root or os.getcwd())
+    modules: List[SourceModule] = []
+    for f in collect_py_files(paths):
+        try:
+            modules.append(SourceModule.parse(f, root))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            if errors is not None:
+                errors.append(f"{f}: {e}")
+    return Project(root=root, modules=modules)
+
+
+def lint_paths(paths: Sequence[str], root: Optional[str] = None,
+               rules: Optional[Iterable[str]] = None,
+               check_markers: bool = False,
+               tests_dir: Optional[str] = None,
+               pytest_ini: Optional[str] = None,
+               errors: Optional[List[str]] = None) -> List[Finding]:
+    """Run every rule family over ``paths``; returns suppressed-filtered
+    findings sorted by (path, line, rule). ``rules`` limits to rule-id /
+    family prefixes (e.g. ``{"SYNC", "LOCK001"}``)."""
+    from . import rules_sync, rules_trace, rules_lock, rules_config
+    project = load_project(paths, root=root, errors=errors)
+    findings: List[Finding] = []
+    findings += rules_sync.run(project)
+    findings += rules_trace.run(project)
+    findings += rules_lock.run(project)
+    findings += rules_config.run(project)
+    if check_markers:
+        findings += rules_config.check_pytest_markers(
+            project.root, tests_dir=tests_dir, pytest_ini=pytest_ini)
+    if rules:
+        pref = tuple(rules)
+        findings = [f for f in findings if f.rule.startswith(pref)]
+    by_rel = {m.rel: m for m in project.modules}
+    findings = [f for f in findings
+                if f.path not in by_rel or not by_rel[f.path].suppressed(f)]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

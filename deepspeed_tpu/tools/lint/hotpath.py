@@ -1,0 +1,361 @@
+"""Hot-path discovery: which functions run jitted, which run per-step.
+
+Two hazard scopes drive the SYNC/TRACE families:
+
+  * **jit-hot** — functions that execute under a ``jax.jit`` trace:
+    decorated with ``@jax.jit`` / ``@partial(jax.jit, ...)``, passed to a
+    ``jax.jit(...)`` call (including lambdas), or reachable from one via
+    the intra/inter-module call graph (a call made while tracing is
+    itself traced).
+  * **step-hot** — functions on the per-step host path: the jit-hot set
+    plus functions named like step entry points (``train_step``,
+    ``eval_loss``, ...) and everything they reach, including functions
+    handed off as references (worker-pool submissions).
+
+Call-graph edges are resolved for: bare names (scope chain), ``self.m``
+methods, ``from . import sibling`` module aliases, and ``from x import
+f`` name imports — enough to follow the streamed train step across
+``infinity.py`` → ``wire_codec.py`` / ``slot_store.py`` without a real
+type system.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Project, SourceModule
+
+#: function names treated as per-step hot-path roots even without jit
+STEP_ROOT_NAMES = {
+    "train_step", "eval_loss", "eval_batch", "train_batch", "forward",
+    "backward", "step_batch",
+}
+
+FuncKey = Tuple[str, str]  # (modname, qualname)
+
+
+@dataclass
+class JitWrap:
+    """One ``jax.jit(...)`` call site (for retrace/static-arg rules)."""
+    module: SourceModule
+    node: ast.Call
+    target: Optional[FuncKey]          # resolved wrapped function
+    static_positions: List[int]        # static_argnums, when literal ints
+    assigned_name: Optional[str]       # n in ``n = jax.jit(f, ...)``
+    scope: str                         # enclosing qualname
+
+
+@dataclass
+class FuncInfo:
+    module: SourceModule
+    qualname: str
+    node: ast.AST                      # FunctionDef/AsyncFunctionDef/Lambda
+    params: List[str]
+    calls: Set[FuncKey] = field(default_factory=set)
+    refs: Set[FuncKey] = field(default_factory=set)
+    jit_root: bool = False
+
+    @property
+    def key(self) -> FuncKey:
+        return (self.module.modname, self.qualname)
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+
+@dataclass
+class HotInfo:
+    funcs: Dict[FuncKey, FuncInfo]
+    jit_hot: Set[FuncKey]
+    step_hot: Set[FuncKey]
+    jit_wraps: List[JitWrap]
+
+    def hot_funcs(self, jit_only: bool = False) -> List[FuncInfo]:
+        keys = self.jit_hot if jit_only else self.step_hot
+        return [self.funcs[k] for k in sorted(keys) if k in self.funcs]
+
+
+def iter_own_nodes(func_node: ast.AST):
+    """Walk a function body without descending into nested function /
+    class definitions (those are separate FuncInfos); plain lambdas are
+    part of the enclosing function and ARE descended into."""
+    stack = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# import / name resolution
+# ---------------------------------------------------------------------------
+class ModuleIndex:
+    """Per-module import tables + function registry."""
+
+    def __init__(self, mod: SourceModule):
+        self.mod = mod
+        self.import_modules: Dict[str, str] = {}    # alias -> dotted module
+        self.from_imports: Dict[str, Tuple[str, str]] = {}  # n -> (mod, attr)
+        self._scan_imports()
+
+    def _resolve_relative(self, level: int, name: Optional[str]) -> str:
+        parts = self.mod.modname.split(".")
+        # a module's package is its parent; level=1 is that package
+        base = parts[: len(parts) - level] if level else parts
+        if name:
+            base = base + name.split(".")
+        return ".".join(base)
+
+    def _scan_imports(self) -> None:
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_modules[a.asname or a.name.split(".")[0]] = \
+                        a.name
+            elif isinstance(node, ast.ImportFrom):
+                src = self._resolve_relative(node.level, node.module)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    # ``from . import wire_codec`` imports a MODULE;
+                    # ``from .retry import retry_call`` imports a name —
+                    # record both, the resolver tries module first
+                    self.import_modules.setdefault(
+                        a.asname or a.name, f"{src}.{a.name}")
+                    self.from_imports[a.asname or a.name] = (src, a.name)
+
+
+def _is_jit_expr(node: ast.AST, idx: ModuleIndex) -> bool:
+    """``jax.jit`` / ``jit`` / ``pjit`` (by import or attribute)."""
+    if isinstance(node, ast.Attribute) and node.attr in ("jit", "pjit"):
+        root = node.value
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        return isinstance(root, ast.Name) and root.id == "jax"
+    if isinstance(node, ast.Name) and node.id in ("jit", "pjit"):
+        tgt = idx.from_imports.get(node.id)
+        return tgt is not None and tgt[0].split(".")[0] == "jax"
+    return False
+
+
+def _jit_from_decorator(dec: ast.AST, idx: ModuleIndex) -> bool:
+    if _is_jit_expr(dec, idx):
+        return True
+    if isinstance(dec, ast.Call):
+        # @jax.jit(...)-style or @partial(jax.jit, ...)
+        if _is_jit_expr(dec.func, idx):
+            return True
+        fn = dec.func
+        is_partial = (isinstance(fn, ast.Name) and fn.id == "partial") or \
+            (isinstance(fn, ast.Attribute) and fn.attr == "partial")
+        if is_partial and dec.args and _is_jit_expr(dec.args[0], idx):
+            return True
+    return False
+
+
+def _static_positions(call: ast.Call) -> List[int]:
+    out: List[int] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in vals:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.append(e.value)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-module function/call collection
+# ---------------------------------------------------------------------------
+class _Collector(ast.NodeVisitor):
+    def __init__(self, mod: SourceModule, idx: ModuleIndex,
+                 funcs: Dict[FuncKey, FuncInfo], wraps: List[JitWrap]):
+        self.mod = mod
+        self.idx = idx
+        self.funcs = funcs
+        self.wraps = wraps
+        # scope stack entries: (qualname, {simple-name: qualname}, kind)
+        self.scopes: List[Tuple[str, Dict[str, str], str]] = [
+            ("", {}, "module")]
+        self._register_scope_defs(mod.tree, "")
+
+    # -- registration ------------------------------------------------------
+    def _register_scope_defs(self, node: ast.AST, prefix: str) -> None:
+        table = self.scopes[-1][1]
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                table[child.name] = q
+            elif isinstance(child, ast.ClassDef) and not prefix:
+                for sub in ast.iter_child_nodes(child):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        table.setdefault(
+                            f"{child.name}.{sub.name}",
+                            f"{child.name}.{sub.name}")
+
+    def _qual(self, name: str) -> str:
+        prefix = self.scopes[-1][0]
+        return f"{prefix}.{name}" if prefix else name
+
+    # -- resolution --------------------------------------------------------
+    def _resolve(self, node: ast.AST) -> Optional[FuncKey]:
+        """Expression -> (module, qualname) of a known project function."""
+        if isinstance(node, ast.Name):
+            for qual, table, _kind in reversed(self.scopes):
+                if node.id in table:
+                    return (self.mod.modname, table[node.id])
+            tgt = self.idx.from_imports.get(node.id)
+            if tgt is not None:
+                return (tgt[0], tgt[1])
+            return None
+        if isinstance(node, ast.Attribute):
+            val = node.value
+            if isinstance(val, ast.Name) and val.id in ("self", "cls"):
+                cls = self._enclosing_class()
+                if cls:
+                    return (self.mod.modname, f"{cls}.{node.attr}")
+                return None
+            if isinstance(val, ast.Name) and \
+                    val.id in self.idx.import_modules:
+                return (self.idx.import_modules[val.id], node.attr)
+        return None
+
+    def _enclosing_class(self) -> Optional[str]:
+        for qual, _table, kind in reversed(self.scopes):
+            if kind == "class":
+                return qual
+        return None
+
+    def _current_func(self) -> Optional[FuncInfo]:
+        for qual, _table, kind in reversed(self.scopes):
+            if kind == "func":
+                return self.funcs.get((self.mod.modname, qual))
+        return None
+
+    # -- visitors ----------------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        qual = self._qual(node.name)
+        self.scopes.append((qual, {}, "class"))
+        self._register_scope_defs(node, qual)
+        self.generic_visit(node)
+        self.scopes.pop()
+
+    def _visit_func(self, node) -> None:
+        qual = self._qual(node.name)
+        params = [a.arg for a in (node.args.posonlyargs + node.args.args +
+                                  node.args.kwonlyargs)
+                  if a.arg not in ("self", "cls")]
+        info = FuncInfo(module=self.mod, qualname=qual, node=node,
+                        params=params)
+        info.jit_root = any(_jit_from_decorator(d, self.idx)
+                            for d in node.decorator_list)
+        self.funcs[info.key] = info
+        self.scopes.append((qual, {}, "func"))
+        self._register_scope_defs(node, qual)
+        self.generic_visit(node)
+        self.scopes.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Call(self, node: ast.Call) -> None:
+        cur = self._current_func()
+        if _is_jit_expr(node.func, self.idx):
+            target: Optional[FuncKey] = None
+            if node.args:
+                a0 = node.args[0]
+                if isinstance(a0, ast.Lambda):
+                    q = self._qual(f"<lambda:{a0.lineno}>")
+                    info = FuncInfo(
+                        module=self.mod, qualname=q, node=a0,
+                        params=[a.arg for a in a0.args.args],
+                        jit_root=True)
+                    self.funcs[info.key] = info
+                    target = info.key
+                else:
+                    target = self._resolve(a0)
+                    if target is not None and target in self.funcs:
+                        self.funcs[target].jit_root = True
+            assigned = None
+            parent = getattr(node, "_dstpu_parent", None)
+            if isinstance(parent, ast.Assign) and \
+                    len(parent.targets) == 1 and \
+                    isinstance(parent.targets[0], ast.Name):
+                assigned = parent.targets[0].id
+            self.wraps.append(JitWrap(
+                module=self.mod, node=node, target=target,
+                static_positions=_static_positions(node),
+                assigned_name=assigned,
+                scope=self.scopes[-1][0]))
+        elif cur is not None:
+            tgt = self._resolve(node.func)
+            if tgt is not None:
+                cur.calls.add(tgt)
+            # function references passed as arguments escape into worker
+            # pools / callbacks — treat as edges too
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                ref = self._resolve(a)
+                if ref is not None:
+                    cur.refs.add(ref)
+        self.generic_visit(node)
+
+
+def _annotate_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._dstpu_parent = node  # type: ignore[attr-defined]
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+def get_hot(project: Project) -> HotInfo:
+    """Cached ``analyze`` — SYNC and TRACE share one call-graph walk."""
+    cached = getattr(project, "_hot_info", None)
+    if cached is None:
+        cached = analyze(project)
+        project._hot_info = cached  # type: ignore[attr-defined]
+    return cached
+
+
+def analyze(project: Project) -> HotInfo:
+    funcs: Dict[FuncKey, FuncInfo] = {}
+    wraps: List[JitWrap] = []
+    for mod in project.modules:
+        _annotate_parents(mod.tree)
+        idx = ModuleIndex(mod)
+        _Collector(mod, idx, funcs, wraps).visit(mod.tree)
+    # lambdas registered during the walk may be jit targets recorded
+    # before resolution; mark any wrap target that exists now
+    for w in wraps:
+        if w.target is not None and w.target in funcs:
+            funcs[w.target].jit_root = True
+
+    def closure(roots: Set[FuncKey]) -> Set[FuncKey]:
+        seen = set()
+        stack = [r for r in roots if r in funcs]
+        while stack:
+            k = stack.pop()
+            if k in seen:
+                continue
+            seen.add(k)
+            info = funcs.get(k)
+            if info is None:
+                continue
+            for nxt in info.calls | info.refs:
+                if nxt in funcs and nxt not in seen:
+                    stack.append(nxt)
+        return seen
+
+    jit_roots = {k for k, f in funcs.items() if f.jit_root}
+    step_roots = jit_roots | {k for k, f in funcs.items()
+                              if f.name in STEP_ROOT_NAMES}
+    return HotInfo(funcs=funcs, jit_hot=closure(jit_roots),
+                   step_hot=closure(step_roots), jit_wraps=wraps)

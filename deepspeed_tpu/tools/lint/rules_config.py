@@ -1,0 +1,196 @@
+"""CFG — config-schema consistency between constants.py and config.py.
+
+The JSON config surface lives in two files that must agree:
+``runtime/constants.py`` declares the key strings and defaults,
+``runtime/config.py`` consumes them. A constant nobody reads is a knob
+users set that silently does nothing; a raw string key in the parser is
+a knob the constants file does not know exists. Both are schema drift.
+
+  CFG001  key constant (string-valued) consumed nowhere in the package
+  CFG002  ``*_DEFAULT`` constant consumed nowhere in the package
+  CFG003  raw string key in config.py's parser instead of a constant
+
+``check_pytest_markers`` (wired into the CI lint stage) adds:
+
+  TEST001  ``pytest.mark.<name>`` used in tests/ but not registered in
+           pytest.ini — typo'd markers silently select nothing
+"""
+from __future__ import annotations
+
+import ast
+import configparser
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Project, Severity
+
+#: built-in pytest markers that need no registration
+_BUILTIN_MARKERS = {
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings", "tryfirst", "trylast",
+}
+
+_CONST_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+
+
+def _collect_constants(tree: ast.Module) -> Dict[str, Tuple[object, int]]:
+    out: Dict[str, Tuple[object, int]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if _CONST_RE.match(name):
+                value = (node.value.value
+                         if isinstance(node.value, ast.Constant) else None)
+                out[name] = (value, node.lineno)
+    return out
+
+
+def _identifier_usage(project: Project, skip_rel: str) -> Set[str]:
+    """Every attribute/name identifier used anywhere but ``skip_rel`` —
+    the cheap global consumption check (C.NAME and from-imported NAME
+    both land here)."""
+    used: Set[str] = set()
+    for mod in project.modules:
+        if mod.rel == skip_rel:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute):
+                used.add(node.attr)
+            elif isinstance(node, ast.Name):
+                used.add(node.id)
+    return used
+
+
+def _raw_key_calls(tree: ast.Module) -> List[Tuple[str, ast.AST]]:
+    """String literals used as config keys: ``g("k")``, ``pd.get("k")``,
+    ``pd["k"]`` — anywhere in the config module."""
+    out: List[Tuple[str, ast.AST]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            # only the master-dict getters: ``g(...)`` (the local alias
+            # of pd.get) and ``pd.get(...)`` — sub-dict .get() reads are
+            # not top-level schema keys
+            is_getter = (isinstance(f, ast.Name) and f.id == "g") or \
+                (isinstance(f, ast.Attribute) and f.attr == "get"
+                 and isinstance(f.value, ast.Name) and f.value.id == "pd")
+            if is_getter and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                out.append((node.args[0].value, node.args[0]))
+        elif isinstance(node, ast.Subscript) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "pd" and \
+                isinstance(node.slice, ast.Constant) and \
+                isinstance(node.slice.value, str):
+            out.append((node.slice.value, node.slice))
+    return out
+
+
+def run(project: Project) -> List[Finding]:
+    consts_mod = project.by_rel("runtime/constants.py")
+    config_mod = project.by_rel("runtime/config.py")
+    if consts_mod is None or config_mod is None:
+        return []
+    findings: List[Finding] = []
+    constants = _collect_constants(consts_mod.tree)
+    used = _identifier_usage(project, consts_mod.rel)
+    key_values: Set[str] = set()
+    for name, (value, line) in sorted(constants.items()):
+        is_default = name.endswith("_DEFAULT")
+        if not is_default and isinstance(value, str):
+            key_values.add(value)
+        if name in used:
+            continue
+        if is_default:
+            findings.append(Finding(
+                rule="CFG002", severity=Severity.WARNING,
+                path=consts_mod.rel, line=line, col=0,
+                message=f"default constant {name} is consumed nowhere — "
+                        f"the schema default it encodes is dead",
+                detail=name))
+        elif isinstance(value, str):
+            findings.append(Finding(
+                rule="CFG001", severity=Severity.WARNING,
+                path=consts_mod.rel, line=line, col=0,
+                message=f"config key constant {name} "
+                        f"({value!r}) is consumed nowhere — users who "
+                        f"set this key get a silent no-op",
+                detail=name))
+    for value, node in _raw_key_calls(config_mod.tree):
+        if value in key_values:
+            continue
+        findings.append(Finding(
+            rule="CFG003", severity=Severity.WARNING,
+            path=config_mod.rel, line=node.lineno, col=node.col_offset,
+            message=f"raw config key {value!r} in the parser has no "
+                    f"constant in runtime/constants.py — declare it so "
+                    f"the schema stays in one place",
+            detail=value))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# TEST001 — pytest marker registration
+# ---------------------------------------------------------------------------
+def _markers_in_file(path: str) -> List[Tuple[str, int, int]]:
+    """AST-level ``pytest.mark.<name>`` usages (name, line, col) —
+    parsing (not grepping) so marker names inside string literals, e.g.
+    lint-test fixtures, do not count."""
+    with open(path, "r", encoding="utf-8") as f:
+        try:
+            tree = ast.parse(f.read(), filename=path)
+        except SyntaxError:
+            return []
+    out: List[Tuple[str, int, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Attribute) and \
+                node.value.attr == "mark" and \
+                isinstance(node.value.value, ast.Name) and \
+                node.value.value.id == "pytest":
+            out.append((node.attr, node.lineno, node.col_offset))
+    return out
+
+
+def registered_markers(pytest_ini: str) -> Set[str]:
+    cp = configparser.ConfigParser()
+    cp.read(pytest_ini)
+    out: Set[str] = set()
+    if cp.has_option("pytest", "markers"):
+        for line in cp.get("pytest", "markers").splitlines():
+            line = line.strip()
+            if line:
+                out.add(line.split(":", 1)[0].strip())
+    return out
+
+
+def check_pytest_markers(root: str, tests_dir: Optional[str] = None,
+                         pytest_ini: Optional[str] = None
+                         ) -> List[Finding]:
+    tests_dir = tests_dir or os.path.join(root, "tests")
+    pytest_ini = pytest_ini or os.path.join(root, "pytest.ini")
+    if not os.path.isdir(tests_dir) or not os.path.isfile(pytest_ini):
+        return []
+    known = registered_markers(pytest_ini) | _BUILTIN_MARKERS
+    findings: List[Finding] = []
+    for dirpath, dirnames, filenames in os.walk(tests_dir):
+        dirnames[:] = sorted(d for d in dirnames
+                             if not d.startswith((".", "__")))
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            for name, lineno, col in _markers_in_file(path):
+                if name not in known:
+                    findings.append(Finding(
+                        rule="TEST001", severity=Severity.ERROR,
+                        path=rel, line=lineno, col=col,
+                        message=f"pytest marker `{name}` is not "
+                                f"registered in pytest.ini — "
+                                f"`-m {name}` silently selects nothing",
+                        detail=name))
+    return findings

@@ -1,0 +1,360 @@
+"""LOCK — threaded shared-state and lock-discipline hazards.
+
+The swap/offload stores and the elasticity layer are the places this
+framework genuinely multithreads (stream thread + optimizer workers +
+agent watchdogs), and they synchronize with plain ``threading`` locks.
+These rules check the discipline the stores document but Python cannot
+enforce:
+
+  LOCK001  attribute accessed under ``with self._lock`` in one method
+           and MUTATED outside any lock in another — the unlocked write
+           races the locked readers
+  LOCK002  lock-acquisition-order inversion: ``with A: with B:`` in one
+           place and ``with B: with A:`` in another — a deadlock waiting
+           for the right interleaving
+  LOCK003  ``threading.Thread`` that is neither ``daemon=True`` nor
+           ever ``.join()``-ed — leaks on crash, blocks interpreter exit
+
+Interprocedural refinement: a private method whose every in-class call
+site holds the lock is analyzed as lock-held-on-entry (the
+``_free_buf``/``_submit_*`` pattern in ``slot_store.py``), so
+callee-side mutations do not false-positive. ``Condition(self._lock)``
+aliases to its backing lock.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .core import Finding, Project, Severity, SourceModule
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_MUTATOR_METHODS = {"append", "extend", "insert", "pop", "popleft",
+                    "popitem", "clear", "update", "add", "remove",
+                    "discard", "setdefault", "appendleft", "sort",
+                    "reverse"}
+_CTOR_METHODS = {"__init__", "__new__", "__post_init__", "__del__"}
+
+
+def _self_path(node: ast.AST) -> Optional[str]:
+    """Dotted attribute path rooted at ``self`` ('_buf_op',
+    'opt.step_count'); subscripts collapse onto their container."""
+    if isinstance(node, ast.Subscript):
+        return _self_path(node.value)
+    if isinstance(node, ast.Attribute):
+        base = _self_path(node.value)
+        if base is None:
+            return None
+        return f"{base}.{node.attr}" if base else node.attr
+    if isinstance(node, ast.Name) and node.id == "self":
+        return ""
+    return None
+
+
+@dataclass
+class _Access:
+    path: str
+    is_mutation: bool
+    held: FrozenSet[str]
+    method: str
+    node: ast.AST
+
+
+class _ClassAnalysis:
+    def __init__(self, mod: SourceModule, cls: ast.ClassDef):
+        self.mod = mod
+        self.cls = cls
+        self.methods: Dict[str, ast.AST] = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.lock_alias: Dict[str, str] = {}   # attr -> canonical lock attr
+        self.accesses: List[_Access] = []
+        # (caller, callee, locks-held-at-site)
+        self.call_sites: List[Tuple[str, str, FrozenSet[str]]] = []
+        # locks acquired (canonical) anywhere inside each method body
+        self.acquires: Dict[str, Set[str]] = {}
+        # ordered nested acquisition pairs -> first site
+        self.pairs: Dict[Tuple[str, str], ast.AST] = {}
+        self._find_locks()
+        if self.lock_alias:
+            for name, body in self.methods.items():
+                self.acquires.setdefault(name, set())
+                self._walk_stmts(list(ast.iter_child_nodes(body)),
+                                 frozenset(), name)
+            self._locked_entry = self._fixpoint_locked_entry()
+            self._interprocedural_pairs()
+
+    # -- lock discovery ----------------------------------------------------
+    def _find_locks(self) -> None:
+        for body in self.methods.values():
+            for node in ast.walk(body):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                callee = node.value.func
+                cname = (callee.attr if isinstance(callee, ast.Attribute)
+                         else callee.id if isinstance(callee, ast.Name)
+                         else "")
+                if cname not in _LOCK_CTORS:
+                    continue
+                for t in node.targets:
+                    path = _self_path(t)
+                    if not path or "." in path:
+                        continue
+                    backing = path
+                    if cname == "Condition" and node.value.args:
+                        arg = _self_path(node.value.args[0])
+                        if arg and arg in self.lock_alias:
+                            backing = self.lock_alias[arg]
+                        elif arg:
+                            backing = arg
+                    self.lock_alias[path] = backing
+
+    def _canon(self, path: Optional[str]) -> Optional[str]:
+        if path is None:
+            return None
+        return self.lock_alias.get(path)
+
+    # -- body walk ---------------------------------------------------------
+    def _walk_stmts(self, stmts, held: FrozenSet[str],
+                    method: str) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue  # nested defs: separate execution context
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                new = []
+                for item in st.items:
+                    lock = self._canon(_self_path(item.context_expr))
+                    if lock is not None:
+                        self.acquires[method].add(lock)
+                        for h in held:
+                            if h != lock and (h, lock) not in self.pairs:
+                                self.pairs[(h, lock)] = st
+                        if lock not in held:
+                            new.append(lock)
+                    else:
+                        self._record_expr(item.context_expr, held, method)
+                self._walk_stmts(st.body, held | frozenset(new), method)
+                continue
+            # classify this statement's own expressions, then recurse
+            # into compound-statement bodies with the same held set
+            self._record_stmt(st, held, method)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(st, field, None)
+                if sub:
+                    self._walk_stmts(sub, held, method)
+            for h in getattr(st, "handlers", []) or []:
+                self._walk_stmts(h.body, held, method)
+
+    def _record_stmt(self, st: ast.stmt, held: FrozenSet[str],
+                     method: str) -> None:
+        if isinstance(st, ast.Assign):
+            for t in st.targets:
+                self._record_target(t, held, method)
+            self._record_expr(st.value, held, method)
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            self._record_target(st.target, held, method)
+            if st.value is not None:
+                self._record_expr(st.value, held, method)
+        elif isinstance(st, ast.Delete):
+            for t in st.targets:
+                self._record_target(t, held, method)
+        else:
+            for field, value in ast.iter_fields(st):
+                if field in ("body", "orelse", "finalbody", "handlers"):
+                    continue
+                for item in (value if isinstance(value, list)
+                             else [value]):
+                    if isinstance(item, ast.expr):
+                        self._record_expr(item, held, method)
+
+    def _record_target(self, t: ast.AST, held: FrozenSet[str],
+                       method: str) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._record_target(e, held, method)
+            return
+        path = _self_path(t)
+        if path:
+            self.accesses.append(_Access(path, True, held, method, t))
+        # index expressions inside the target are reads
+        if isinstance(t, ast.Subscript):
+            self._record_expr(t.slice, held, method)
+
+    def _record_expr(self, e: ast.AST, held: FrozenSet[str],
+                     method: str) -> None:
+        for node in ast.walk(e):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    base = _self_path(f.value)
+                    if base == "":
+                        # ``self.method(...)`` — an intra-class call site
+                        if f.attr in self.methods:
+                            self.call_sites.append((method, f.attr, held))
+                    elif base and f.attr in _MUTATOR_METHODS:
+                        self.accesses.append(_Access(
+                            base, True, held, method, node))
+            elif isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load):
+                path = _self_path(node)
+                if path:
+                    self.accesses.append(_Access(
+                        path, False, held, method, node))
+
+    # -- interprocedural ---------------------------------------------------
+    def _fixpoint_locked_entry(self) -> Dict[str, bool]:
+        sites: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+        for caller, callee, held in self.call_sites:
+            sites.setdefault(callee, []).append((caller, held))
+        locked: Dict[str, bool] = {m: False for m in self.methods}
+        for _ in range(len(self.methods) + 1):
+            changed = False
+            for m in self.methods:
+                if locked[m] or not m.startswith("_") or \
+                        m.startswith("__"):
+                    continue
+                ss = sites.get(m)
+                if ss and all(held or locked[caller]
+                              for caller, held in ss):
+                    locked[m] = True
+                    changed = True
+            if not changed:
+                break
+        return locked
+
+    def _interprocedural_pairs(self) -> None:
+        # a call made while holding A into a method that acquires B is an
+        # (A, B) ordering too
+        for caller, callee, held in self.call_sites:
+            if not held:
+                continue
+            for b in self.acquires.get(callee, ()):
+                for a in held:
+                    if a != b and (a, b) not in self.pairs:
+                        self.pairs[(a, b)] = self.methods[callee]
+
+    # -- findings ----------------------------------------------------------
+    def findings(self) -> List[Finding]:
+        if not self.lock_alias:
+            return []
+        out: List[Finding] = []
+        lock_names = set(self.lock_alias) | set(self.lock_alias.values())
+        locked_paths: Set[str] = set()
+        for a in self.accesses:
+            if a.held or self._locked_entry.get(a.method):
+                locked_paths.add(a.path)
+        seen: Set[Tuple[str, int]] = set()
+        for a in self.accesses:
+            if not a.is_mutation or a.held:
+                continue
+            if a.method in _CTOR_METHODS or \
+                    self._locked_entry.get(a.method):
+                continue
+            root = a.path.split(".")[0]
+            if root in lock_names or a.path not in locked_paths:
+                continue
+            key = (a.path, a.node.lineno)
+            if key in seen:
+                continue
+            seen.add(key)
+            lock = self.lock_alias[next(iter(self.lock_alias))]
+            out.append(Finding(
+                rule="LOCK001", severity=Severity.ERROR,
+                path=self.mod.rel, line=a.node.lineno,
+                col=a.node.col_offset,
+                message=f"self.{a.path} is mutated in "
+                        f"{self.cls.name}.{a.method} without the lock "
+                        f"but accessed under `with self.{lock}` "
+                        f"elsewhere — racy against concurrent holders",
+                scope=f"{self.cls.name}.{a.method}",
+                detail=a.path))
+        for (a, b), site in sorted(self.pairs.items()):
+            if (b, a) in self.pairs and a < b:
+                other = self.pairs[(b, a)]
+                out.append(Finding(
+                    rule="LOCK002", severity=Severity.ERROR,
+                    path=self.mod.rel, line=site.lineno,
+                    col=site.col_offset,
+                    message=f"lock-order inversion in {self.cls.name}: "
+                            f"{a}→{b} here but {b}→{a} at line "
+                            f"{other.lineno} — deadlock under the right "
+                            f"interleaving",
+                    scope=self.cls.name, detail=f"{a}<->{b}"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# LOCK003 — threads that are neither daemon nor joined
+# ---------------------------------------------------------------------------
+def _is_thread_ctor(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name) and f.id == "Thread":
+        return True
+    return (isinstance(f, ast.Attribute) and f.attr == "Thread"
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "threading")
+
+
+def _daemon_true(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg == "daemon":
+            return isinstance(kw.value, ast.Constant) and \
+                bool(kw.value.value)
+    return False
+
+
+def _joined(mod: SourceModule, target: Optional[str],
+            self_attr: Optional[str]) -> bool:
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"):
+            continue
+        v = node.func.value
+        if target and isinstance(v, ast.Name) and v.id == target:
+            return True
+        if self_attr and _self_path(v) == self_attr:
+            return True
+    return False
+
+
+def _check_threads(mod: SourceModule, findings: List[Finding]) -> None:
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+            continue
+        if _daemon_true(node):
+            continue
+        parent = getattr(node, "_dstpu_parent", None)
+        target = self_attr = None
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+            t = parent.targets[0]
+            if isinstance(t, ast.Name):
+                target = t.id
+            else:
+                self_attr = _self_path(t)
+        if _joined(mod, target, self_attr):
+            continue
+        name = target or self_attr or "<unbound>"
+        findings.append(Finding(
+            rule="LOCK003", severity=Severity.WARNING,
+            path=mod.rel, line=node.lineno, col=node.col_offset,
+            message=f"threading.Thread `{name}` is neither daemon=True "
+                    f"nor ever .join()-ed — it leaks on crash and "
+                    f"blocks interpreter exit",
+            detail=name))
+
+
+def run(project: Project) -> List[Finding]:
+    from .hotpath import _annotate_parents
+    findings: List[Finding] = []
+    for mod in project.modules:
+        _annotate_parents(mod.tree)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                findings += _ClassAnalysis(mod, node).findings()
+        _check_threads(mod, findings)
+    return findings

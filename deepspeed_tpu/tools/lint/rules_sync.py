@@ -1,0 +1,155 @@
+"""SYNC — host-sync hazards reachable from jit/step hot paths.
+
+A TPU step is fast only while the host keeps dispatching ahead of the
+device; one innocent ``float(loss)`` in the wrong loop stalls the
+pipeline for a full round trip. These rules flag blocking device→host
+syncs in functions the hot-path walk (``hotpath.py``) proves reachable
+from a jitted program or a step entry point.
+
+  SYNC001  ``.item()`` call
+  SYNC002  ``float()`` / ``int()`` of a computed (possibly device) value
+  SYNC003  explicit transfer — ``np.asarray`` / ``np.array`` /
+           ``jax.device_get`` / ``block_until_ready`` — not routed
+           through the annotated ``host_transfer()`` helper
+
+Deliberate transfers go through ``host_transfer()``
+(`runtime/utils.py`), which the linter whitelists: the point is not
+zero syncs, it is zero *unaccounted* syncs.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .core import Finding, Project, Severity
+from .hotpath import FuncInfo, get_hot, iter_own_nodes
+
+#: the one blessed sync point — calls to it (and its own body) are exempt
+HOST_TRANSFER = "host_transfer"
+
+#: calls that return plain host scalars; float()/int() of these is fine
+_HOST_SCALAR_CALLS = {
+    "len", "str", "ord", "round", "id", "hash", "getattr", "int", "float",
+    "bool", "sum", "perf_counter", "monotonic", "time", "process_time",
+    "get", "getpid", "cpu_count", "prod", HOST_TRANSFER,
+}
+
+#: (root-name, attr) or bare attr names that force a blocking transfer
+_TRANSFER_ATTRS = {"asarray", "array", "device_get", "block_until_ready",
+                   "copy_to_host", "ascontiguousarray"}
+_TRANSFER_ROOTS = {"np", "numpy", "jax", "onp"}
+
+
+def _callee_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _src(node: ast.AST, limit: int = 48) -> str:
+    try:
+        s = ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse failures
+        s = "<expr>"
+    return s if len(s) <= limit else s[: limit - 3] + "..."
+
+
+def _computed_names(func_node: ast.AST) -> Set[str]:
+    """Names assigned from expressions containing a non-host call —
+    float()/int() of those is treated as a potential device sync."""
+    out: Set[str] = set()
+    for node in iter_own_nodes(func_node):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = node.value
+            if value is None:
+                continue
+            has_call = any(
+                isinstance(n, ast.Call)
+                and _callee_name(n) not in _HOST_SCALAR_CALLS
+                for n in ast.walk(value))
+            if not has_call:
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+    return out
+
+
+def _is_transfer_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _TRANSFER_ATTRS:
+        root = f.value
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        if isinstance(root, ast.Name) and root.id in _TRANSFER_ROOTS:
+            return True
+        # bare method form: ``x.block_until_ready()`` / ``x.copy_to_host()``
+        return f.attr in ("block_until_ready", "copy_to_host")
+    if isinstance(f, ast.Name) and f.id in ("device_get",
+                                            "block_until_ready"):
+        return True
+    return False
+
+
+def _check_func(info: FuncInfo, in_jit: bool, findings: List[Finding]
+                ) -> None:
+    if info.name == HOST_TRANSFER:
+        return
+    sev = Severity.ERROR if in_jit else Severity.WARNING
+    where = ("inside a jitted function" if in_jit
+             else "on a step hot path")
+    computed = _computed_names(info.node)
+    for node in iter_own_nodes(info.node):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "item" \
+                and not node.args:
+            findings.append(Finding(
+                rule="SYNC001", severity=sev, path=info.module.rel,
+                line=node.lineno, col=node.col_offset,
+                message=f"`{_src(node)}` blocks on a device→host sync "
+                        f"{where}",
+                scope=info.qualname, detail=f"item:{_src(f.value, 32)}"))
+            continue
+        if isinstance(f, ast.Name) and f.id in ("float", "int") \
+                and len(node.args) == 1 and not node.keywords:
+            a = node.args[0]
+            suspicious = (
+                (isinstance(a, ast.Call)
+                 and _callee_name(a) not in _HOST_SCALAR_CALLS)
+                or (isinstance(a, ast.Name) and a.id in computed))
+            if suspicious:
+                findings.append(Finding(
+                    rule="SYNC002", severity=sev, path=info.module.rel,
+                    line=node.lineno, col=node.col_offset,
+                    message=f"`{_src(node)}` forces a blocking device "
+                            f"sync {where}; keep the value lazy and "
+                            f"convert after the step",
+                    scope=info.qualname,
+                    detail=f"{f.id}:{_src(a, 32)}"))
+            continue
+        if _is_transfer_call(node):
+            findings.append(Finding(
+                rule="SYNC003", severity=sev, path=info.module.rel,
+                line=node.lineno, col=node.col_offset,
+                message=f"`{_src(node)}` is a device→host transfer "
+                        f"{where}; route deliberate syncs through "
+                        f"{HOST_TRANSFER}()",
+                scope=info.qualname,
+                detail=f"{_callee_name(node)}:{_src(node.args[0], 32) if node.args else ''}"))
+
+
+def run(project: Project) -> List[Finding]:
+    hot = get_hot(project)
+    findings: List[Finding] = []
+    for info in hot.hot_funcs():
+        _check_func(info, in_jit=info.key in hot.jit_hot,
+                    findings=findings)
+    return findings

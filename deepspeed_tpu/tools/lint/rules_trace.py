@@ -1,0 +1,264 @@
+"""TRACE — retrace and tracer-leak hazards inside jitted functions.
+
+XLA compiles a jitted function once per (shape, dtype, static-arg)
+signature; anything that peeks at a traced VALUE either crashes at
+trace time or silently bakes a constant into the compiled program, and
+anything unhashable in a static slot defeats the compile cache — a
+retrace bomb that turns every step into a compile.
+
+  TRACE001  Python ``if``/``while`` on a traced value (param-tainted,
+            not a ``.shape``/``.dtype``/``is None``/``isinstance`` test)
+  TRACE002  impure host call (``time.*``, ``np.random.*``, ``random.*``,
+            ``datetime``, ``uuid``, ``os.urandom``) baked in at trace
+            time — ``jax.random`` is the functional, traceable API
+  TRACE003  ``jax.jit`` constructed per call: immediately invoked
+            (``jax.jit(f)(x)``) or built inside a loop — recompiles
+            every iteration instead of hitting the jit cache
+  TRACE004  unhashable literal (list/dict/set) passed in a
+            ``static_argnums`` position — raises at call time
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Project, Severity
+from .hotpath import FuncInfo, JitWrap, get_hot, iter_own_nodes
+
+#: attribute projections of a traced array that are static Python values
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "nbytes"}
+
+#: call names whose result is static even with traced arguments
+_STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "type", "id"}
+
+#: dotted-prefix -> trace-impurity (jax.random is functional and exempt)
+_IMPURE_PREFIXES = (
+    "time.", "random.", "np.random.", "numpy.random.", "datetime.",
+    "uuid.", "os.urandom",
+)
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a call target ('np.random.rand')."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _src(node: ast.AST, limit: int = 48) -> str:
+    try:
+        s = ast.unparse(node)
+    except Exception:  # pragma: no cover
+        s = "<expr>"
+    return s if len(s) <= limit else s[: limit - 3] + "..."
+
+
+def _is_static_occurrence(name_node: ast.Name) -> bool:
+    """A tainted name used only through a static projection is fine:
+    ``x.shape[0]``, ``len(x)``, ``isinstance(x, T)``, ``x is None``."""
+    node: ast.AST = name_node
+    parent = getattr(node, "_dstpu_parent", None)
+    while parent is not None:
+        if isinstance(parent, ast.Attribute) and \
+                parent.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(parent, ast.Call):
+            callee = parent.func
+            if isinstance(callee, ast.Name) and \
+                    callee.id in _STATIC_CALLS and node is not callee:
+                return True
+            # the name being CALLED is not a data use of a tracer
+            if node is callee:
+                return True
+        if isinstance(parent, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in parent.ops):
+            return True
+        if isinstance(parent, (ast.stmt,)):
+            break
+        node, parent = parent, getattr(parent, "_dstpu_parent", None)
+    return False
+
+
+def _tainted_names(expr: ast.AST, taint: Set[str]) -> List[ast.Name]:
+    return [n for n in ast.walk(expr)
+            if isinstance(n, ast.Name) and n.id in taint
+            and not _is_static_occurrence(n)]
+
+
+def _compute_taint(info: FuncInfo,
+                   static_params: Set[str]) -> Set[str]:
+    """Params (minus static_argnums) plus names assigned from them."""
+    taint: Set[str] = {p for p in info.params if p not in static_params}
+    for _ in range(8):  # bounded fixpoint over assignment chains
+        grew = False
+        for node in iter_own_nodes(info.node):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                value = node.value
+                if value is None or not _tainted_names(value, taint):
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name) and n.id not in taint:
+                            taint.add(n.id)
+                            grew = True
+        if not grew:
+            break
+    return taint
+
+
+def _static_params_for(info: FuncInfo, wraps: List[JitWrap]) -> Set[str]:
+    """Params of ``info`` made static via static_argnums at a jit site
+    or a @partial(jax.jit, static_argnums=...) decorator."""
+    out: Set[str] = set()
+    positions: List[int] = []
+    for w in wraps:
+        if w.target == info.key:
+            positions += w.static_positions
+    for dec in getattr(info.node, "decorator_list", []):
+        if isinstance(dec, ast.Call):
+            positions += [
+                e.value
+                for kw in dec.keywords if kw.arg == "static_argnums"
+                for e in (kw.value.elts
+                          if isinstance(kw.value, (ast.Tuple, ast.List))
+                          else [kw.value])
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    for p in positions:
+        if 0 <= p < len(info.params):
+            out.add(info.params[p])
+    return out
+
+
+def _check_traced_branches(info: FuncInfo, wraps: List[JitWrap],
+                           findings: List[Finding]) -> None:
+    taint = _compute_taint(info, _static_params_for(info, wraps))
+    if not taint:
+        return
+    for node in iter_own_nodes(info.node):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        hits = _tainted_names(node.test, taint)
+        if not hits:
+            continue
+        kind = "if" if isinstance(node, ast.If) else "while"
+        findings.append(Finding(
+            rule="TRACE001", severity=Severity.ERROR,
+            path=info.module.rel, line=node.lineno, col=node.col_offset,
+            message=f"Python `{kind}` on traced value "
+                    f"`{hits[0].id}` inside a jitted function — use "
+                    f"jax.lax.cond/jnp.where or mark the argument "
+                    f"static",
+            scope=info.qualname,
+            detail=f"{kind}:{hits[0].id}"))
+
+
+def _check_impure_calls(info: FuncInfo, findings: List[Finding]) -> None:
+    for node in iter_own_nodes(info.node):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if not dotted or dotted.startswith("jax."):
+            continue
+        if any(dotted == p.rstrip(".") or dotted.startswith(p)
+               for p in _IMPURE_PREFIXES):
+            findings.append(Finding(
+                rule="TRACE002", severity=Severity.ERROR,
+                path=info.module.rel, line=node.lineno,
+                col=node.col_offset,
+                message=f"`{_src(node)}` inside a jitted function is "
+                        f"evaluated ONCE at trace time and baked into "
+                        f"the compiled program (use jax.random / pass "
+                        f"host values as arguments)",
+                scope=info.qualname, detail=dotted))
+
+
+def _enclosing_loop(node: ast.AST) -> Optional[ast.AST]:
+    parent = getattr(node, "_dstpu_parent", None)
+    while parent is not None and not isinstance(
+            parent, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                     ast.Module)):
+        if isinstance(parent, (ast.For, ast.While, ast.AsyncFor)):
+            return parent
+        parent = getattr(parent, "_dstpu_parent", None)
+    return None
+
+
+def _check_retrace(wraps: List[JitWrap], findings: List[Finding]) -> None:
+    for w in wraps:
+        parent = getattr(w.node, "_dstpu_parent", None)
+        if isinstance(parent, ast.Call) and parent.func is w.node:
+            findings.append(Finding(
+                rule="TRACE003", severity=Severity.WARNING,
+                path=w.module.rel, line=w.node.lineno,
+                col=w.node.col_offset,
+                message="jax.jit(...) result is called immediately — a "
+                        "fresh compile per invocation; cache the jitted "
+                        "callable",
+                scope=w.scope, detail="immediate-call"))
+            continue
+        loop = _enclosing_loop(w.node)
+        if loop is not None:
+            findings.append(Finding(
+                rule="TRACE003", severity=Severity.WARNING,
+                path=w.module.rel, line=w.node.lineno,
+                col=w.node.col_offset,
+                message="jax.jit(...) constructed inside a loop — the "
+                        "compile cache is keyed on the callable object, "
+                        "so every iteration retraces; hoist the jit out "
+                        "of the loop",
+                scope=w.scope, detail="jit-in-loop"))
+
+
+def _check_static_hashability(project: Project, wraps: List[JitWrap],
+                              findings: List[Finding]) -> None:
+    # jit results assigned to a name in some scope: find later calls of
+    # that name in the same module and check static positions
+    by_mod: Dict[str, List[JitWrap]] = {}
+    for w in wraps:
+        if w.assigned_name and w.static_positions:
+            by_mod.setdefault(w.module.modname, []).append(w)
+    for mod in project.modules:
+        for w in by_mod.get(mod.modname, []):
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == w.assigned_name):
+                    continue
+                for pos in w.static_positions:
+                    if pos >= len(node.args):
+                        continue
+                    a = node.args[pos]
+                    if isinstance(a, (ast.List, ast.Dict, ast.Set,
+                                      ast.ListComp, ast.DictComp,
+                                      ast.SetComp)):
+                        findings.append(Finding(
+                            rule="TRACE004", severity=Severity.ERROR,
+                            path=mod.rel, line=a.lineno,
+                            col=a.col_offset,
+                            message=f"unhashable `{_src(a, 32)}` passed "
+                                    f"in static_argnums position {pos} "
+                                    f"of `{w.assigned_name}` — static "
+                                    f"args must be hashable (tuple it)",
+                            detail=f"{w.assigned_name}:{pos}"))
+
+
+def run(project: Project) -> List[Finding]:
+    hot = get_hot(project)
+    findings: List[Finding] = []
+    for info in hot.hot_funcs(jit_only=True):
+        # TRACE001 only on DIRECT jit roots: their params are known
+        # traced; a propagated callee may receive closure constants
+        # (e.g. wire_codec.encode's ``bits``) that legitimately branch
+        if info.jit_root:
+            _check_traced_branches(info, hot.jit_wraps, findings)
+        _check_impure_calls(info, findings)
+    _check_retrace(hot.jit_wraps, findings)
+    _check_static_hashability(project, hot.jit_wraps, findings)
+    return findings

@@ -20,6 +20,20 @@ FAILED=()
 PASSED=0
 T0=$(date +%s)
 
+# Static analysis first — dstpu-lint (tools/lint, docs/lint.md) runs in
+# seconds, needs no jax, and fails on any TPU-hazard/concurrency/schema
+# finding beyond the committed baseline. --check-markers also verifies
+# every pytest marker used under tests/ is registered in pytest.ini.
+if [[ -z "$FILTER" || "lint" == *"$FILTER"* ]]; then
+  echo "=== dstpu-lint (static analysis, baseline-gated)"
+  if python bin/dstpu-lint deepspeed_tpu \
+       --baseline lint_baseline.json --check-markers; then
+    PASSED=$((PASSED + 1))
+  else
+    FAILED+=("dstpu-lint")
+  fi
+fi
+
 for f in tests/unit/test_*.py; do
   if [[ -n "$FILTER" && "$f" != *"$FILTER"* ]]; then
     continue

@@ -93,3 +93,33 @@ def test_mesh_block():
     cfg = DeepSpeedConfig({"train_batch_size": 8,
                            "mesh": {"data": 2, "model": 4}}, world_size=2)
     assert cfg.mesh.model == 4
+
+
+def test_max_grad_norm_legacy_alias():
+    """Top-level max_grad_norm (legacy DeepSpeed) maps onto
+    gradient_clipping instead of being silently ignored (dstpu-lint
+    CFG001 finding, fixed in the static-analysis PR)."""
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "max_grad_norm": 0.5},
+                          world_size=1)
+    assert cfg.gradient_clipping == 0.5
+    # agreeing duplicate is fine; disagreeing duplicate is an error
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "max_grad_norm": 0.5,
+                           "gradient_clipping": 0.5}, world_size=1)
+    assert cfg.gradient_clipping == 0.5
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"train_batch_size": 8, "max_grad_norm": 0.5,
+                         "gradient_clipping": 1.0}, world_size=1)
+
+
+def test_amp_rejected_not_ignored():
+    """An amp block that asks for mixed precision must raise (apex is
+    CUDA-specific), not silently train unscaled — in both the dict and
+    the bare-bool shorthand forms. Disabled amp parses fine."""
+    with pytest.raises(NotImplementedError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "amp": {"enabled": True}}, world_size=1)
+    with pytest.raises(NotImplementedError):
+        DeepSpeedConfig({"train_batch_size": 8, "amp": True}, world_size=1)
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "amp": {"enabled": False}}, world_size=1)
+    assert cfg.train_batch_size == 8
