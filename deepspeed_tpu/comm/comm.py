@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..observability import trace_span
 from ..utils.logging import logger
 from .comms_logging import get_comms_logger
 
@@ -162,14 +163,16 @@ def barrier(group=None) -> None:
     """Block until all pending local device work completes; on multi-host
     pods additionally rendezvous all processes (so rank-0-writes-then-
     everyone-reads checkpoint patterns are safe)."""
-    for d in jax.local_devices():
-        try:
-            jnp.zeros((), device=d).block_until_ready()
-        except Exception:  # axes/platform without explicit placement
-            jnp.zeros(()).block_until_ready()
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("deepspeed_tpu.comm.barrier")
+    with trace_span("comm/barrier", processes=jax.process_count()):
+        for d in jax.local_devices():
+            try:
+                jnp.zeros((), device=d).block_until_ready()
+            except Exception:  # axes/platform without explicit placement
+                jnp.zeros(()).block_until_ready()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(
+                "deepspeed_tpu.comm.barrier")
 
 
 # ---------------------------------------------------------------------------
@@ -178,8 +181,14 @@ def barrier(group=None) -> None:
 def _log(op_name: str, tensor, axis_name) -> None:
     cl = get_comms_logger()
     if cl is not None and cl.enabled:
+        try:
+            # axis size is static at trace time — it feeds the busbw
+            # correction factor in log_summary (calc_bw_factor)
+            n = int(axis_size(axis_name))
+        except Exception:   # axis not in scope (direct call outside trace)
+            n = 0
         cl.record(op_name, int(tensor.size) * tensor.dtype.itemsize,
-                  str(axis_name))
+                  str(axis_name), n=n)
 
 
 def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, axis_name: str = "data"):
@@ -267,7 +276,12 @@ def axis_index(axis_name: str):
 
 
 def axis_size(axis_name: str):
-    return lax.axis_size(axis_name)
+    """Participant count on ``axis_name``. ``lax.axis_size`` only exists
+    on newer jax; psum of the constant 1 is the version-portable form —
+    it folds to the axis size at trace time (no collective emitted)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
 
 
 def log_summary() -> str:

@@ -39,24 +39,40 @@ class CommsLogger:
             if v is not None and hasattr(self, k):
                 setattr(self, k, v)
 
-    def record(self, op_name: str, nbytes: int, axis_name: str) -> None:
+    def record(self, op_name: str, nbytes: int, axis_name: str,
+               n: int = 0) -> None:
+        """``n`` — axis size (number of participants), known exactly at
+        trace time; 0 when the caller could not resolve it."""
         if not (self.prof_all or op_name in self.prof_ops):
             return
         rec = self.comms_dict[op_name][(nbytes, axis_name)]
         rec["count"] += 1
         rec["volume"] += nbytes
+        if n:
+            rec["n"] = n
         if self.verbose:
             from ..utils.logging import logger
             logger.info(f"comm op: {op_name} | axis: {axis_name} | "
                         f"msg size: {nbytes} bytes (trace)")
 
     def log_summary(self) -> str:
+        """Volume table with the busbw correction applied: ``BW factor``
+        is ``calc_bw_factor(op, n)`` — the reference get_bw's
+        volume-on-wire / payload ratio (2(n-1)/n for all_reduce,
+        (n-1)/n for all_gather/reduce_scatter/all_to_all) — and ``Wire
+        volume`` = payload x factor, the bytes that actually cross the
+        interconnect. A 1-member axis (or unknown n) reports factor 0:
+        no inter-chip traffic."""
         lines = [f"{'Op':<16}{'Axis':<12}{'Msg size':>12}{'Count':>8}"
-                 f"{'Total volume':>16}"]
+                 f"{'Total volume':>16}{'BW factor':>11}"
+                 f"{'Wire volume':>16}"]
         for op_name, sizes in sorted(self.comms_dict.items()):
             for (nbytes, axis_name), rec in sorted(sizes.items()):
+                factor = calc_bw_factor(op_name, rec.get("n", 0))
+                wire = int(rec["volume"] * factor)
                 lines.append(f"{op_name:<16}{axis_name:<12}{nbytes:>12}"
-                             f"{rec['count']:>8}{rec['volume']:>16}")
+                             f"{rec['count']:>8}{rec['volume']:>16}"
+                             f"{factor:>11.3f}{wire:>16}")
         out = "\n".join(lines)
         from ..utils.logging import logger
         logger.info("\n" + out)

@@ -38,6 +38,7 @@ import subprocess
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..observability import get_registry, trace_span
 from ..utils.logging import logger
 from .elasticity import ElasticityError, compute_elastic_config
 from .store import make_store
@@ -79,6 +80,15 @@ class FileRendezvous:
         """Announce, settle, decide (or read the decision). Returns
         {"members": [...], "counts": {node: n_workers},
         "world_size": W, "offsets": {node: first_rank}}."""
+        with trace_span("elastic/rendezvous", gen=gen, node=self.node):
+            dec = self._join(gen, valid_worlds, timeout_s)
+        # counts GENERATIONS joined: a climbing rate means churn — some
+        # node keeps dying or re-rendezvousing
+        get_registry().counter("dstpu_rendezvous_total").inc()
+        return dec
+
+    def _join(self, gen: int, valid_worlds: Sequence[int],
+              timeout_s: float) -> Dict:
         self.store.set(f"gen_{gen}/member_{self.node}.json",
                        {"slots": self.slots, "ts": time.time()})
         self.heartbeat(gen)

@@ -89,8 +89,14 @@ class WandbMonitor(Monitor):
     def write_events(self, events: Sequence[Event]) -> None:
         if not self.enabled:
             return
+        # batch events sharing a step into ONE wandb.log call: per-event
+        # calls pay per-call overhead AND clobber the run's step cursor
+        # (wandb treats each log(step=N) after a later step as stale)
+        by_step: dict = {}
         for name, value, step in events:
-            self._wandb.log({name: value}, step=step)
+            by_step.setdefault(step, {})[name] = value
+        for step in sorted(by_step):
+            self._wandb.log(by_step[step], step=step)
 
     def close(self) -> None:
         if self.enabled:

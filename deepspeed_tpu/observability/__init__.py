@@ -1,0 +1,139 @@
+"""Unified telemetry: span tracer + metrics registry (docs/observability.md).
+
+One process-global :class:`~.tracer.SpanTracer` and one
+:class:`~.metrics.MetricsRegistry`, configured from the master config's
+``observability`` block (``runtime/config.py`` ``ObservabilityConfig``)
+by whichever engine comes up first. Instrumentation sites across the
+stack use the module helpers:
+
+    from ..observability import trace_span, get_registry
+
+    with trace_span("checkpoint/save", tag=tag):
+        ...
+    get_registry().counter("dstpu_io_retries_total").inc()
+
+Span naming convention: ``subsystem/event`` with subsystem one of
+``engine | pipe | offload | infinity | swap | checkpoint | comm |
+elastic`` — the subsystem becomes the natural Perfetto search prefix.
+Metric naming: Prometheus style, ``dstpu_<noun>_<unit>[_total]``.
+
+With the block disabled (the default), ``trace_span`` is a single
+attribute check returning a shared no-op and nothing here touches the
+device — the acceptance contract the integration test pins.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+from typing import Any, List, Optional, Tuple
+
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
+                      sanitize_name)
+from .tracer import NULL_SPAN, SpanTracer  # noqa: F401
+
+_tracer = SpanTracer()
+_registry = MetricsRegistry()
+_export = {"prometheus_dir": None, "json_path": None,
+           "interval_steps": 0}
+_atexit_armed = False
+
+
+def get_tracer() -> SpanTracer:
+    return _tracer
+
+
+def get_registry() -> MetricsRegistry:
+    return _registry
+
+
+def trace_span(name: str, cat: str = "", **args):
+    """Span context manager; the disabled path is one attribute check."""
+    t = _tracer
+    if not t.enabled:
+        return NULL_SPAN
+    return t.span(name, cat, **args)
+
+
+#: metrics pre-registered at configure time so the very first Prometheus
+#: textfile already carries every core series (a counter that appears
+#: only after its first increment breaks rate() on restart)
+_CORE_METRICS = (
+    ("counter", "dstpu_train_steps_total",
+     "optimizer steps taken (engine train_step)"),
+    ("counter", "dstpu_train_skipped_steps_total",
+     "steps skipped on overflow / non-finite grad norm (resilience)"),
+    ("counter", "dstpu_io_retries_total",
+     "transient I/O failures retried (runtime/resilience retry_call)"),
+    ("counter", "dstpu_io_retry_giveups_total",
+     "I/O operations that exhausted the retry budget"),
+    ("counter", "dstpu_jit_programs_built_total",
+     "jit programs traced+compiled by the engine (recompile watermark)"),
+    ("counter", "dstpu_checkpoint_saves_total", "checkpoint save calls"),
+    ("counter", "dstpu_checkpoint_loads_total", "checkpoint load calls"),
+    ("counter", "dstpu_rendezvous_total",
+     "elastic rendezvous generations joined"),
+    ("histogram", "dstpu_step_time_seconds",
+     "synchronized train-step wall time"),
+    ("gauge", "dstpu_swap_queue_depth",
+     "in-flight NVMe slot-store aio operations"),
+    ("gauge", "dstpu_device_peak_memory_bytes",
+     "device memory high-water mark (memory_stats)"),
+)
+
+
+def _register_core_metrics() -> None:
+    for kind, name, help in _CORE_METRICS:
+        getattr(_registry, kind)(name, help=help)
+
+
+def configure(obs_config: Any = None, rank: int = 0
+              ) -> Tuple[SpanTracer, MetricsRegistry]:
+    """Apply an ``ObservabilityConfig`` (or None → all off) to the
+    process-global tracer/registry. Idempotent; the newest engine wins —
+    telemetry is per-process, not per-engine."""
+    global _atexit_armed
+    if obs_config is None:
+        _tracer.configure(enabled=False)
+        _registry.enabled = False
+        return _tracer, _registry
+    tr = obs_config.tracing
+    mt = obs_config.metrics
+    _tracer.configure(enabled=tr.enabled, capacity=tr.buffer_size,
+                      output_dir=tr.output_dir, rank=rank)
+    _registry.enabled = bool(mt.enabled)
+    _export["prometheus_dir"] = mt.prometheus_dir
+    _export["json_path"] = mt.json_path
+    _export["interval_steps"] = int(mt.export_interval_steps or 0)
+    if mt.enabled:
+        _register_core_metrics()
+    if (tr.enabled or mt.enabled) and not _atexit_armed:
+        atexit.register(flush_all)
+        _atexit_armed = True
+    return _tracer, _registry
+
+
+def export_metrics() -> List[str]:
+    """Write the configured metric exports (Prometheus textfile + JSON)."""
+    if not _registry.enabled:
+        return []
+    paths: List[str] = []
+    if _export["prometheus_dir"]:
+        paths.append(_registry.export_prometheus(os.path.join(
+            _export["prometheus_dir"], f"dstpu_rank{_tracer.rank}.prom")))
+    if _export["json_path"]:
+        paths.append(_registry.export_json(_export["json_path"]))
+    return paths
+
+
+def export_interval_steps() -> int:
+    return _export["interval_steps"]
+
+
+def flush_all(sync: Any = None) -> List[str]:
+    """Flush trace + metric exports. ``sync`` — optional device value to
+    join first (the explicit flush-boundary sync, via host_transfer)."""
+    paths: List[str] = []
+    if _tracer.enabled:
+        paths.append(_tracer.flush(sync=sync))
+    paths.extend(export_metrics())
+    return paths

@@ -1,0 +1,266 @@
+"""Metrics registry: counters / gauges / histograms with JSON and
+Prometheus-textfile exporters.
+
+The scalar half of the telemetry layer (spans live in ``tracer.py``).
+Sites across the stack feed signals that previously died in local state:
+resilience retry/give-up counts, skipped optimizer steps, jit program
+builds, step-time distribution, comms volume, swap queue depth, device
+memory watermark. Export formats:
+
+  - Prometheus textfile (node_exporter textfile-collector convention:
+    write ``<dir>/dstpu_rank<r>.prom`` atomically, let the collector
+    scrape it) — fleet dashboards;
+  - JSON snapshot — ad-hoc tooling and tests;
+  - ``to_events(step)`` — the existing ``MonitorMaster`` fan-out, so
+    TensorBoard/CSV/W&B see every scalar for free.
+
+Everything here is stdlib-only and never touches the device: collectors
+that read device-adjacent state (memory_stats, comms logs) are plain
+host calls registered by their owners via ``set_collector``.
+"""
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+#: default histogram boundaries — seconds, spanning 100 µs .. 60 s (step
+#: times, I/O latencies); override per-histogram for other units
+DEFAULT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                   0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                   30.0, 60.0)
+
+
+def sanitize_name(name: str) -> str:
+    """Map an arbitrary span/op name onto the Prometheus charset."""
+    out = []
+    for ch in name:
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    s = "".join(out)
+    if s and s[0].isdigit():
+        s = "_" + s
+    return s
+
+
+class Counter:
+    """Monotonically increasing count."""
+    kind = "counter"
+    __slots__ = ("name", "help", "_lock", "_value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Last-written value."""
+    kind = "gauge"
+    __slots__ = ("name", "help", "_lock", "_value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics)."""
+    kind = "histogram"
+    __slots__ = ("name", "help", "buckets", "_lock", "_counts", "_sum",
+                 "_count")
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Optional[Sequence[float]] = None):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)   # +inf tail
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def value(self) -> float:
+        """Mean observation (the scalar fed to MonitorMaster)."""
+        return self._sum / self._count if self._count else 0.0
+
+    def cumulative(self) -> List[Tuple[float, int]]:
+        """[(le, cumulative_count), ...] ending with (+inf, count)."""
+        with self._lock:
+            counts = list(self._counts)
+        out, acc = [], 0
+        for le, c in zip(self.buckets, counts):
+            acc += c
+            out.append((le, acc))
+        out.append((math.inf, acc + counts[-1]))
+        return out
+
+
+class MetricsRegistry:
+    """Name-keyed get-or-create registry.
+
+    ``enabled`` gates only the per-step feeds in the engine and the
+    exporters; rare-event sites (retry loops, rendezvous) increment
+    unconditionally — the cost is nanoseconds and the history is there
+    the moment an operator turns export on.
+    """
+
+    def __init__(self):
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, Any] = {}
+        self._collectors: Dict[str, Callable[[], None]] = {}
+
+    # -- creation ----------------------------------------------------------
+    def _get_or_create(self, cls, name: str, help: str, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help=help, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+            self._collectors.clear()
+
+    # -- collectors --------------------------------------------------------
+    def set_collector(self, name: str, fn: Callable[[], None]) -> None:
+        """Register (or replace) a pre-export hook that refreshes derived
+        gauges — keyed by name so re-built engines don't stack stale
+        closures."""
+        with self._lock:
+            self._collectors[name] = fn
+
+    def collect(self) -> None:
+        with self._lock:
+            fns = list(self._collectors.values())
+        for fn in fns:
+            try:
+                fn()
+            except Exception:   # a broken collector must not kill export
+                pass
+
+    # -- export ------------------------------------------------------------
+    def to_events(self, step: int, prefix: str = "Metrics/"
+                  ) -> List[Tuple[str, float, int]]:
+        """MonitorMaster-shaped [(name, value, step), ...]."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        return [(prefix + name, float(m.value), step)
+                for name, m in metrics]
+
+    def to_json(self) -> Dict[str, Any]:
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        out: Dict[str, Any] = {}
+        for name, m in metrics:
+            if m.kind == "histogram":
+                out[name] = {"kind": m.kind, "sum": m.sum,
+                             "count": m.count, "mean": m.value,
+                             "buckets": [[le if le != math.inf else "+Inf",
+                                          c] for le, c in m.cumulative()]}
+            else:
+                out[name] = {"kind": m.kind, "value": m.value}
+        return out
+
+    def export_json(self, path: str) -> str:
+        self.collect()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.to_json(), f, indent=2)
+        os.replace(tmp, path)
+        return path
+
+    def to_prometheus(self) -> str:
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        lines: List[str] = []
+        for name, m in metrics:
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            if m.kind == "histogram":
+                for le, c in m.cumulative():
+                    le_s = "+Inf" if le == math.inf else repr(float(le))
+                    lines.append(f'{name}_bucket{{le="{le_s}"}} {c}')
+                lines.append(f"{name}_sum {m.sum!r}")
+                lines.append(f"{name}_count {m.count}")
+            else:
+                lines.append(f"{name} {m.value!r}")
+        return "\n".join(lines) + "\n"
+
+    def export_prometheus(self, path: str) -> str:
+        """Atomic write — the node_exporter textfile collector must never
+        read a torn file."""
+        self.collect()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(self.to_prometheus())
+        os.replace(tmp, path)
+        return path

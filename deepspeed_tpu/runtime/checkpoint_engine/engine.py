@@ -29,6 +29,7 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
+from ...observability import get_registry, trace_span
 from ...utils.logging import logger
 from ..resilience import (CheckpointCorruptionError, FatalIOError,
                           atomic_write_json, atomic_write_text,
@@ -74,16 +75,20 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         # and it bounds hard-kill metadata loss to the single in-flight
         # checkpoint rather than every checkpoint since the last load.
         wait_pending()
+    get_registry().counter("dstpu_checkpoint_saves_total").inc()
     state = dict(engine.state)
     scaler = state.pop("scaler", None)
     if scaler is not None:
         state["scaler"] = dict(scaler._asdict())
-    ckptr.save(os.path.join(path, "state"), state, force=True)
+    with trace_span("checkpoint/save_state", tag=str(tag),
+                    async_save=async_save):
+        ckptr.save(os.path.join(path, "state"), state, force=True)
 
     if getattr(engine, "_infinity", None) is not None:
         # ZeRO-Infinity: the entire model lives in the host/NVMe stores —
         # streamed slot-by-slot into the tag dir (constant memory)
-        engine._infinity.save_to_dir(os.path.join(path, "infinity"))
+        with trace_span("checkpoint/infinity_stream", tag=str(tag)):
+            engine._infinity.save_to_dir(os.path.join(path, "infinity"))
 
     if getattr(engine, "_host_opt", None) is not None:
         # ZeRO-Offload host state (masters + moments, numpy) — saved
@@ -170,8 +175,10 @@ def _publish(save_dir: str, tag: str, meta: dict, resilience=None) -> None:
         atomic_write_text(os.path.join(save_dir, "latest"), str(tag))
         fsync_dir(save_dir)
 
-    retry_call(_commit, policy=policy_from_config(resilience),
-               what=f"checkpoint publish '{tag}'")
+    with trace_span("checkpoint/publish", tag=str(tag),
+                    integrity=integrity, verify=verify):
+        retry_call(_commit, policy=policy_from_config(resilience),
+                   what=f"checkpoint publish '{tag}'")
 
 
 def wait_pending(engine=None) -> None:
@@ -256,11 +263,13 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     """Restore into the engine's CURRENT shardings (topology may differ from
     the saving job — orbax reshards on read)."""
     wait_pending()
+    get_registry().counter("dstpu_checkpoint_loads_total").inc()
     explicit = tag is not None
     tag = _validate_tag(engine, load_dir, tag)
     if tag is None:
         return None, {}
-    tag = _resolve_verified_tag(engine, load_dir, tag, explicit)
+    with trace_span("checkpoint/verify", tag=str(tag)):
+        tag = _resolve_verified_tag(engine, load_dir, tag, explicit)
     path = _tag_path(load_dir, tag)
     if not os.path.isdir(path):
         # reachable only with checkpoint_integrity disabled (the resolver
@@ -302,12 +311,18 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             restore = ocp.args.PyTreeRestore(item=params_target,
                                              restore_args=restore_args,
                                              transforms={})
-        restored = ckptr.restore(os.path.join(path, "state"), args=restore)
+        with trace_span("checkpoint/load_state", tag=str(tag),
+                        partial=True):
+            restored = ckptr.restore(os.path.join(path, "state"),
+                                     args=restore)
         engine.state["params"] = restored["params"]
         engine.state["step"] = restored["step"]
     else:
-        restored = _checkpointer().restore(
-            os.path.join(path, "state"), ocp.args.StandardRestore(target))
+        with trace_span("checkpoint/load_state", tag=str(tag),
+                        partial=False):
+            restored = _checkpointer().restore(
+                os.path.join(path, "state"),
+                ocp.args.StandardRestore(target))
         if "scaler" in restored and hasattr(engine, "loss_scaler") \
                 and engine.loss_scaler is not None:
             from ..fp16 import LossScaleState

@@ -374,6 +374,60 @@ class ResilienceConfig(ConfigModel):
         return self
 
 
+class TracingConfig(ConfigModel):
+    """``observability.tracing`` — host-side span tracer
+    (deepspeed_tpu/observability/tracer.py). Spans record into a
+    preallocated ring buffer and export as Chrome trace-event JSON
+    (Perfetto-loadable); device syncs happen only at explicit flush
+    boundaries via ``host_transfer()``."""
+    enabled: bool = C.OBSERVABILITY_TRACING_ENABLED_DEFAULT
+    # ring capacity in spans; oldest spans are overwritten on wraparound
+    buffer_size: int = C.OBSERVABILITY_TRACE_BUFFER_DEFAULT
+    # directory for per-process trace_rank<r>.json files
+    output_dir: str = C.OBSERVABILITY_TRACE_DIR_DEFAULT
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.buffer_size < 1:
+            raise ValueError(
+                f"observability.tracing.buffer_size must be >= 1, got "
+                f"{self.buffer_size}")
+        return self
+
+
+class ObsMetricsConfig(ConfigModel):
+    """``observability.metrics`` — counter/gauge/histogram registry with
+    Prometheus-textfile and JSON exporters
+    (deepspeed_tpu/observability/metrics.py). Scalars also flow into the
+    MonitorMaster fan-out (TB/CSV/W&B) when a monitor is enabled."""
+    enabled: bool = C.OBSERVABILITY_METRICS_ENABLED_DEFAULT
+    # node_exporter textfile-collector directory (dstpu_rank<r>.prom)
+    prometheus_dir: Optional[str] = None
+    # JSON snapshot path
+    json_path: Optional[str] = None
+    # export every N steps (0 = only at flush/close/atexit)
+    export_interval_steps: int = C.OBSERVABILITY_EXPORT_INTERVAL_DEFAULT
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.export_interval_steps < 0:
+            raise ValueError(
+                f"observability.metrics.export_interval_steps must be "
+                f">= 0, got {self.export_interval_steps}")
+        return self
+
+
+class ObservabilityConfig(ConfigModel):
+    """``observability`` block (deepspeed_tpu/observability/,
+    docs/observability.md)."""
+    tracing: TracingConfig = Field(default_factory=TracingConfig)
+    metrics: ObsMetricsConfig = Field(default_factory=ObsMetricsConfig)
+
+    @property
+    def enabled(self) -> bool:
+        return self.tracing.enabled or self.metrics.enabled
+
+
 # ---------------------------------------------------------------------------
 # Master config
 # ---------------------------------------------------------------------------
@@ -467,6 +521,7 @@ class DeepSpeedConfig:
         self.checkpoint_config = CheckpointConfig(**g(C.CHECKPOINT, {}))
         self.comms_config = CommsConfig(**g(C.COMMS_LOGGER, {}))
         self.resilience = ResilienceConfig(**g(C.RESILIENCE, {}))
+        self.observability = ObservabilityConfig(**g(C.OBSERVABILITY, {}))
 
         # Late imports to avoid cycles; these blocks are parsed by their
         # subsystems on first use.

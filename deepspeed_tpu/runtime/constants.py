@@ -67,6 +67,7 @@ CHECKPOINT = "checkpoint"
 TENSOR_PARALLEL = "tensor_parallel"
 RESILIENCE = "resilience"
 COMMS_LOGGER = "comms_logger"
+OBSERVABILITY = "observability"
 
 #############################################
 # Defaults
@@ -100,6 +101,15 @@ RESILIENCE_IO_RETRY_JITTER_DEFAULT = 0.25       # fraction of each delay
 RESILIENCE_SKIP_NONFINITE_DEFAULT = True
 RESILIENCE_HEARTBEAT_INTERVAL_DEFAULT = 1.0     # seconds
 RESILIENCE_WATCHDOG_TIMEOUT_DEFAULT = 0.0       # seconds; 0 disables
+
+# Observability block defaults (deepspeed_tpu/observability/,
+# docs/observability.md). Tracing/metrics are opt-in: the disabled path
+# must stay a no-op attribute check on the step hot path.
+OBSERVABILITY_TRACING_ENABLED_DEFAULT = False
+OBSERVABILITY_TRACE_BUFFER_DEFAULT = 65536      # ring capacity, spans
+OBSERVABILITY_TRACE_DIR_DEFAULT = "traces"
+OBSERVABILITY_METRICS_ENABLED_DEFAULT = False
+OBSERVABILITY_EXPORT_INTERVAL_DEFAULT = 0       # steps; 0 = flush-only
 
 ROUTE_TRAIN = "train"
 ROUTE_EVAL = "eval"

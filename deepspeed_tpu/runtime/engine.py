@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..observability import get_registry, trace_span
 from ..parallel import topology as topo
 from ..utils.logging import logger
 from . import lr_schedules
@@ -45,6 +46,12 @@ from .resilience import Heartbeat
 from .zero.sharding import ZeroShardingPolicy, constrain, to_named
 
 MEM_EFFICIENT_LINEAR_DEFAULT = True
+
+
+def _count_jit_build() -> None:
+    """Recompile watermark: every jit program the engine constructs bumps
+    this counter — a rising value mid-run means a retrace bomb."""
+    get_registry().counter("dstpu_jit_programs_built_total").inc()
 
 
 def _tree_zeros_f32(tree):
@@ -160,14 +167,22 @@ class DeepSpeedEngine:
         self._step_times: list = []
 
         # -- observability (reference MonitorMaster at engine.py:287,
-        #    ThroughputTimer/EngineTimers at engine.py:149) ----------------
+        #    ThroughputTimer/EngineTimers at engine.py:149; span tracer +
+        #    metrics registry are TPU-native — deepspeed_tpu/observability)
         from ..monitor.monitor import MonitorMaster
+        from ..observability import configure as _obs_configure
         from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
         self.monitor = MonitorMaster(self._config.monitor)
         seq_len = getattr(getattr(model, "config", None), "max_seq_len", 0)
         self.tput_timer = ThroughputTimer(self.train_batch_size, seq_len)
         self.timers = SynchronizedWallClockTimer()
         self._analytic_flops_per_step = None
+        self._tracer, self._obs = _obs_configure(
+            self._config.observability, rank=jax.process_index())
+        if self._obs.enabled:
+            # derived gauges refreshed at export time (plain host reads —
+            # memory_stats and the comms log never sync the device)
+            self._obs.set_collector("engine", self._obs_collect)
 
         # -- ZeRO-Offload tiers (host DRAM optimizer / Infinity streaming) -
         from .zero.offload import validate_offload_config
@@ -365,6 +380,7 @@ class DeepSpeedEngine:
 
         with self.mesh:
             self._offload_grad_fn = jax.jit(grad_fn)
+        _count_jit_build()
         return self._offload_grad_fn
 
     @property
@@ -439,12 +455,18 @@ class DeepSpeedEngine:
             self._build_offload_grad_fn()
         gas = self.gradient_accumulation_steps
         scale = self._host_scaler.scale if self._host_scaler else 1.0
-        lsum, grads, gnorm_raw = self._offload_grad_fn(
-            self.state, batch, jnp.asarray(scale, jnp.float32),
-            jax.random.PRNGKey(int(self.state["step"])))
+        wcb = cfg.wall_clock_breakdown
+        if wcb:
+            self.timers("offload/grads").start()
+        with trace_span("offload/grads", gas=gas):
+            lsum, grads, gnorm_raw = self._offload_grad_fn(
+                self.state, batch, jnp.asarray(scale, jnp.float32),
+                jax.random.PRNGKey(int(self.state["step"])))
 
         denom = scale * gas
         gnorm = float(gnorm_raw) / denom
+        if wcb:
+            self.timers("offload/grads").stop()  # the float above synced
         # a non-finite norm skips the host sweep either because the fp16
         # scaler says so or because resilience hygiene does (bf16 offload
         # runs have no scaler but the same poisoned-masters failure mode)
@@ -485,20 +507,31 @@ class DeepSpeedEngine:
                 # pipelined per-bucket path (its wire is DMA, not a
                 # tunnel, and the overlap wins).
                 n_leaves = len(self._host_opt.opt.master)
-                outs = self._host_opt.step(
-                    [fetch_fn(k) for k in range(n_leaves)], lr=lr,
-                    grad_scale=denom / factor, emit_bf16=emit_bf16)
+                if wcb:
+                    self.timers("offload/sweep").start()
+                with trace_span("offload/host_sweep", bucketed=False):
+                    outs = self._host_opt.step(
+                        [fetch_fn(k) for k in range(n_leaves)], lr=lr,
+                        grad_scale=denom / factor, emit_bf16=emit_bf16)
+                if wcb:
+                    self.timers("offload/sweep").stop()
                 flat = np.concatenate(
                     [np.asarray(o).reshape(-1) for o in outs])
                 if up_dtype is not None:
                     flat = flat.astype(up_dtype)
-                new_leaves = self._upload_split_fn(flat.dtype)(flat)
+                with trace_span("offload/upload"):
+                    new_leaves = self._upload_split_fn(flat.dtype)(flat)
             else:
-                new_leaves = self._host_opt.step_pipelined(
-                    grad_dev, self._offload_shardings, lr=lr,
-                    grad_scale=denom / factor,
-                    emit_bf16=emit_bf16, upload_dtype=up_dtype,
-                    fetch_fn=fetch_fn)
+                if wcb:
+                    self.timers("offload/sweep").start()
+                with trace_span("offload/host_sweep", bucketed=True):
+                    new_leaves = self._host_opt.step_pipelined(
+                        grad_dev, self._offload_shardings, lr=lr,
+                        grad_scale=denom / factor,
+                        emit_bf16=emit_bf16, upload_dtype=up_dtype,
+                        fetch_fn=fetch_fn)
+                if wcb:
+                    self.timers("offload/sweep").stop()
             self.state["params"] = jax.tree_util.tree_unflatten(
                 self._host_opt.treedef, new_leaves)
             self.state["step"] = self.state["step"] + 1
@@ -619,6 +652,7 @@ class DeepSpeedEngine:
 
         with self.mesh:
             self._train_step_fn = jax.jit(step_fn, donate_argnums=(0,))
+        _count_jit_build()
         return self._train_step_fn
 
     # ------------------------------------------------------------------
@@ -747,6 +781,7 @@ class DeepSpeedEngine:
             with self.mesh:
                 self._onebit_compiled[key] = jax.jit(step_fn,
                                                      donate_argnums=(0, 1))
+            _count_jit_build()
 
         # error buffers re-zero when a reset-marked phase first activates
         # (reference reinitial_error_buffer, zoadam.py:324)
@@ -826,7 +861,9 @@ class DeepSpeedEngine:
         self._heartbeat.maybe_beat()
         if self.infinity_enabled:
             self.tput_timer.start()
-            metrics = self._infinity.train_step(batch)
+            with trace_span("engine/train_step", mode="infinity",
+                            step=self.global_steps):
+                metrics = self._infinity.train_step(batch)
             self.tput_timer.stop()  # streamed step is synchronous
             self.global_steps += 1
             self.micro_steps += self.gradient_accumulation_steps
@@ -840,10 +877,13 @@ class DeepSpeedEngine:
         if self.offload_enabled:
             if any(not isinstance(v, jax.Array) for v in
                    jax.tree_util.tree_leaves(batch)):
-                batch = self.shard_batch(batch)
+                with trace_span("engine/shard_batch"):
+                    batch = self.shard_batch(batch)
             t0 = time.perf_counter()
             self.tput_timer.start()
-            metrics = self._offload_train_step(batch)
+            with trace_span("engine/train_step", mode="offload",
+                            step=self.global_steps):
+                metrics = self._offload_train_step(batch)
             self.tput_timer.stop()  # host step is synchronous already
             self.global_steps += 1
             self.micro_steps += self.gradient_accumulation_steps
@@ -860,7 +900,8 @@ class DeepSpeedEngine:
             self._build_train_step()
         if any(not isinstance(v, jax.Array) for v in
                jax.tree_util.tree_leaves(batch)):
-            batch = self.shard_batch(batch)
+            with trace_span("engine/shard_batch"):
+                batch = self.shard_batch(batch)
         else:
             gas = self.gradient_accumulation_steps
             for leaf in jax.tree_util.tree_leaves(batch):
@@ -871,15 +912,25 @@ class DeepSpeedEngine:
                         f"arrays or use engine.shard_batch()")
         t0 = time.perf_counter()
         self.tput_timer.start()
-        self.state, metrics = self._train_step_fn(self.state, batch)
+        # the fused step is ONE jitted program — fwd/bwd/allreduce/clip/
+        # optimizer phases live inside XLA (the device profiler's job);
+        # host-side the span pair splits enqueue from device wait
+        with trace_span("engine/train_step", mode="fused",
+                        step=self.global_steps):
+            self.state, metrics = self._train_step_fn(self.state, batch)
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
-        # sync whenever anything CONSUMES the timing (monitor, breakdown, or
-        # the periodic print) — unsynced stop() would time async-dispatch
-        # enqueue, inflating tok/s and MFU by orders of magnitude
+        # sync whenever anything CONSUMES the timing (monitor, breakdown,
+        # metrics registry, or the periodic print) — unsynced stop() would
+        # time async-dispatch enqueue, inflating tok/s and MFU by orders
+        # of magnitude
         sync = (self.monitor.enabled or self._config.wall_clock_breakdown
-                or bool(self._config.steps_per_print))
-        self.tput_timer.stop(sync=metrics["loss"] if sync else None)
+                or bool(self._config.steps_per_print) or self._obs.enabled)
+        if sync:
+            with trace_span("engine/step_sync", step=self.global_steps):
+                self.tput_timer.stop(sync=metrics["loss"])
+        else:
+            self.tput_timer.stop()
         if self._config.wall_clock_breakdown:
             jax.block_until_ready(metrics["loss"])
             self._step_times.append(time.perf_counter() - t0)
@@ -891,15 +942,26 @@ class DeepSpeedEngine:
 
     def _post_step_observe(self, metrics: Dict, batch) -> None:
         """Monitor events at the GAS boundary + periodic log line
-        (reference engine.py:1938 loss writes, :2270 _write_monitor)."""
+        (reference engine.py:1938 loss writes, :2270 _write_monitor).
+        Also the metrics-registry feed point: the step already synced
+        (train_step's sync flag includes the registry), so the float()
+        materializations below are cheap."""
         cfg = self._config
         do_print = cfg.steps_per_print and \
             self.global_steps % cfg.steps_per_print == 0
-        if not (do_print or self.monitor.enabled):
+        obs = self._obs
+        if not (do_print or self.monitor.enabled or obs.enabled):
             return
         m = {k: float(v) for k, v in metrics.items()}
+        step = self.global_steps
+        if obs.enabled:
+            obs.counter("dstpu_train_steps_total").inc()
+            if m.get("overflow"):
+                obs.counter("dstpu_train_skipped_steps_total").inc()
+            dt = self.tput_timer.last_step_time
+            if dt is not None:
+                obs.histogram("dstpu_step_time_seconds").observe(dt)
         if self.monitor.enabled:
-            step = self.global_steps
             events = [("Train/loss", m["loss"], step),
                       ("Train/lr", m["lr"], step),
                       ("Train/grad_norm", m["grad_norm"], step),
@@ -913,8 +975,19 @@ class DeepSpeedEngine:
                 mfu = self._try_mfu(batch)
                 if mfu is not None:
                     events.append(("Train/mfu", mfu, step))
+            if obs.enabled:
+                # registry scalars ride the existing fan-out — TB/CSV/W&B
+                # get every counter/gauge/histogram-mean for free
+                obs.collect()
+                events.extend(obs.to_events(step))
             self.monitor.write_events(events)
             self.monitor.flush()
+        if obs.enabled:
+            from ..observability import (export_interval_steps,
+                                         export_metrics)
+            ivl = export_interval_steps()
+            if ivl and step % ivl == 0:
+                export_metrics()
         if do_print:
             extra = ""
             if self.tput_timer.timed_steps > 0:
@@ -926,6 +999,48 @@ class DeepSpeedEngine:
                 f"step={self.global_steps} loss={m['loss']:.4f} "
                 f"lr={m['lr']:.3e} grad_norm={m['grad_norm']:.3f} "
                 f"loss_scale={m.get('loss_scale', 1.0):.0f}{extra}")
+            if cfg.wall_clock_breakdown and self.timers.timers:
+                # named-timer breakdown; memory_breakdown (the config key)
+                # appends the device/host memory snapshot to the line
+                self.timers.log(sorted(self.timers.timers),
+                                memory_breakdown=cfg.memory_breakdown)
+
+    def _obs_collect(self) -> None:
+        """Export-time refresh of derived gauges: device-memory watermark
+        and comms wire volume. Host-side reads only — ``memory_stats``
+        and the trace-time comms log never block on the device."""
+        obs = self._obs
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            peak = stats.get("peak_bytes_in_use")
+            if peak is not None:
+                obs.gauge("dstpu_device_peak_memory_bytes").set(float(peak))
+        except Exception:
+            pass
+        from ..comm.comms_logging import get_comms_logger
+        from ..observability import sanitize_name
+        cl = get_comms_logger()
+        if cl is not None:
+            for op_name, sizes in cl.comms_dict.items():
+                vol = sum(rec["volume"] for rec in sizes.values())
+                obs.gauge(
+                    f"dstpu_comm_volume_bytes_{sanitize_name(op_name)}",
+                    help="trace-time comms payload volume (CommsLogger)",
+                ).set(float(vol))
+
+    def flush_observability(self, sync: bool = True):
+        """Flush the span trace and metric exports
+        (docs/observability.md). ``sync=True`` first joins the last
+        step's loss via ``host_transfer(block=True)`` — the explicit
+        flush-boundary device sync, so the trace covers fully-executed
+        work. Returns the list of files written."""
+        from ..observability import flush_all
+        val = None
+        if sync:
+            last = getattr(self, "_last_metrics", None)
+            if last:
+                val = last.get("loss")
+        return flush_all(sync=val)
 
     def _try_mfu(self, batch) -> Optional[float]:
         """Engine-reported MFU from ANALYTIC flops (6N + attention) — the
@@ -991,6 +1106,7 @@ class DeepSpeedEngine:
             with self.mesh:
                 self._eval_fn = jax.jit(lambda p, b: self._loss_fn(
                     self._cast_for_compute(p), b))
+            _count_jit_build()
         return self._eval_fn(self.state["params"], sq)
 
     # ------------------------------------------------------------------
@@ -1012,31 +1128,36 @@ class DeepSpeedEngine:
                 return jax.value_and_grad(self._micro_loss)(params, mb, scale)
             with self.mesh:
                 self._grad_fn = jax.jit(gfn)
+            _count_jit_build()
         scale = (self.state["scaler"].scale
                  if self.loss_scaler is not None else 1.0)
-        self._last_loss, self._last_grads = self._grad_fn(
-            self.state["params"], self._last_batch, scale)
+        with trace_span("engine/forward", micro_step=self.micro_steps):
+            self._last_loss, self._last_grads = self._grad_fn(
+                self.state["params"], self._last_batch, scale)
         return self._last_loss / scale if self.fp16_enabled else self._last_loss
 
     def backward(self, loss=None) -> None:
         """Accumulate the grads of the last forward into the GAS buffer."""
         del loss  # grads were produced alongside forward (jit has no tape)
-        grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32),
-                                       self._last_grads)
-        if self._grad_acc is None:
-            self._grad_acc = grads
-        else:
-            # cache the jitted adder: jax.jit keys its compile cache on
-            # the callable object, so a fresh lambda here meant a fresh
-            # trace+compile EVERY microbatch (dstpu-lint TRACE003)
-            if getattr(self, "_grad_acc_add_fn", None) is None:
+        with trace_span("engine/backward", micro_step=self.micro_steps):
+            grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32),
+                                           self._last_grads)
+            if self._grad_acc is None:
+                self._grad_acc = grads
+            else:
+                # cache the jitted adder: jax.jit keys its compile cache on
+                # the callable object, so a fresh lambda here meant a fresh
+                # trace+compile EVERY microbatch (dstpu-lint TRACE003)
+                if getattr(self, "_grad_acc_add_fn", None) is None:
+                    with self.mesh:
+                        self._grad_acc_add_fn = jax.jit(
+                            lambda a, b: jax.tree_util.tree_map(jnp.add,
+                                                                a, b),
+                            donate_argnums=(0,))
+                    _count_jit_build()
                 with self.mesh:
-                    self._grad_acc_add_fn = jax.jit(
-                        lambda a, b: jax.tree_util.tree_map(jnp.add, a, b),
-                        donate_argnums=(0,))
-            with self.mesh:
-                self._grad_acc = self._grad_acc_add_fn(self._grad_acc,
-                                                       grads)
+                    self._grad_acc = self._grad_acc_add_fn(self._grad_acc,
+                                                           grads)
         self._grad_acc_count += 1
         self.micro_steps += 1
 
@@ -1052,9 +1173,11 @@ class DeepSpeedEngine:
                 self._apply_fn = jax.jit(
                     lambda st, g, n: self._apply_grads(st, g, n),
                     donate_argnums=(0, 1))
-        self.state, metrics = self._apply_fn(
-            self.state, self._grad_acc,
-            jnp.asarray(float(self._grad_acc_count), jnp.float32))
+            _count_jit_build()
+        with trace_span("engine/optimizer_step", step=self.global_steps):
+            self.state, metrics = self._apply_fn(
+                self.state, self._grad_acc,
+                jnp.asarray(float(self._grad_acc_count), jnp.float32))
         self._grad_acc = None
         self._grad_acc_count = 0
         self.global_steps += 1

@@ -36,8 +36,9 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ...models import layers as L
+from ...observability import trace_span
 from ...parallel import topology as topo
-from ..engine import DeepSpeedEngine, global_norm
+from ..engine import DeepSpeedEngine, _count_jit_build, global_norm
 from ..zero.sharding import constrain
 
 
@@ -520,9 +521,19 @@ class PipelineEngine(DeepSpeedEngine):
 
         with self.mesh:
             self._train_step_fn = jax.jit(step_fn, donate_argnums=(0,))
+        _count_jit_build()
         return self._train_step_fn
 
     def _build_train_step(self):
+        # the schedule itself runs inside ONE jitted program (per-tick
+        # stage work is the device profiler's domain); the host-side span
+        # marks which schedule was compiled, for how many stages/micros
+        with trace_span("pipe/build_schedule", schedule=self.schedule,
+                        stages=self.num_stages,
+                        micro_batches=self.micro_batches):
+            return self._build_train_step_traced()
+
+    def _build_train_step_traced(self):
         if self.schedule == "1f1b":
             return self._build_1f1b_train_step()
         auto_axes = frozenset(a for a in self.mesh.axis_names
@@ -553,4 +564,5 @@ class PipelineEngine(DeepSpeedEngine):
 
         with self.mesh:
             self._train_step_fn = jax.jit(step_fn, donate_argnums=(0,))
+        _count_jit_build()
         return self._train_step_fn

@@ -30,6 +30,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ...observability import get_registry, trace_span
 from ...ops.aio import (ALIGN, AsyncIOHandle, PinnedBuffer, round_up)
 from ..resilience import get_fault_injector, retry_call
 from ...utils.logging import logger
@@ -164,8 +165,10 @@ class NvmeSlotStore(SlotStore):
     # -- buffer ring ------------------------------------------------------
     def _wait_buf(self, b: int) -> None:
         if self._buf_op[b] is not None:
-            self.aio.wait_op(self._buf_op[b])
+            with trace_span("swap/io_wait"):
+                self.aio.wait_op(self._buf_op[b])
             self._buf_op[b] = None
+            self._observe_depth()
 
     def _free_buf(self) -> int:
         """Next unpinned ring buffer, evicting its previous slot (after any
@@ -224,6 +227,18 @@ class NvmeSlotStore(SlotStore):
             if self._buf_pins[b] == 0:
                 self._cond.notify_all()
 
+    def _observe_depth(self) -> None:
+        """Swap-queue-depth gauge (lock held by every caller): in-flight
+        aio ops across the buffer ring — the backpressure signal for
+        sizing ``buffer_count``/``queue_depth``. Gated on the registry
+        flag: this runs per aio op under the store lock, so the disabled
+        path must stay one attribute check."""
+        reg = get_registry()
+        if not reg.enabled:
+            return
+        reg.gauge("dstpu_swap_queue_depth").set(
+            float(sum(1 for op in self._buf_op if op is not None)))
+
     def _submit_read(self, b: int, slot: int):
         """pread submission through the shared retry policy + the
         ``slot_store.read`` fault site. Submission failures (bad fd,
@@ -233,16 +248,18 @@ class NvmeSlotStore(SlotStore):
             get_fault_injector().check("slot_store.read", path=self.path)
             return self.aio.pread(self._bufs[b].array, self.path,
                                   slot * self.stride)
-        return self._submit_with_retry(
-            b, _do, f"nvme slot read [{self.path}:{slot}]")
+        with trace_span("swap/read_submit", slot=slot):
+            return self._submit_with_retry(
+                b, _do, f"nvme slot read [{self.path}:{slot}]")
 
     def _submit_write(self, b: int, slot: int):
         def _do():
             get_fault_injector().check("slot_store.write", path=self.path)
             return self.aio.pwrite(self._bufs[b].array, self.path,
                                    slot * self.stride)
-        return self._submit_with_retry(
-            b, _do, f"nvme slot write [{self.path}:{slot}]")
+        with trace_span("swap/write_submit", slot=slot):
+            return self._submit_with_retry(
+                b, _do, f"nvme slot write [{self.path}:{slot}]")
 
     # -- API --------------------------------------------------------------
     def prefetch(self, slot: int) -> None:
@@ -262,10 +279,12 @@ class NvmeSlotStore(SlotStore):
                 # our duplicate read on b (so _free_buf drains it before
                 # reuse) but leave b unmapped.
                 self._buf_op[b] = op
+                self._observe_depth()
                 return
             self._buf_op[b] = op
             self._buf_slot[b] = slot
             self._slot_buf[slot] = b
+            self._observe_depth()
 
     def acquire(self, slot: int) -> np.ndarray:
         with self._lock:
@@ -287,6 +306,7 @@ class NvmeSlotStore(SlotStore):
                     self._cond.notify_all()
             if dirty:
                 self._buf_op[b] = self._submit_write(b, slot)
+                self._observe_depth()
             # buffer stays mapped (clean cache) until the ring reclaims it
 
     def flush(self) -> None:

@@ -76,6 +76,7 @@ import jax.numpy as jnp
 import ml_dtypes
 import numpy as np
 
+from ...observability import trace_span
 from ...utils.logging import logger
 from ..resilience import get_fault_injector, policy_from_config, retry_call
 from ..utils import host_transfer
@@ -869,22 +870,23 @@ class InfinityStepper:
                     grad_scale: float) -> None:
         """Worker-thread task: D2H-complete grad → native Adam sweep →
         bf16 emit into the param store slot (stream mode)."""
-        if self.wire_bits:
-            g32 = np.empty(self.n_local, np.float32)
-            self._decode_wire(wire, g32, accumulate=False)
-            # the reported grad_norm must describe the grads actually
-            # APPLIED — the stochastically-rounded decode, not the
-            # pre-quantization device values (advisor r4, low)
-            self._layer_sq[i] = float(np.dot(g32, g32))
-            g = g32
-        else:
-            g = self._fetch_flat(wire).view(np.uint16)  # bf16 wire format
-        self.opt.prefetch(i)
-        pbuf = self.param_store.acquire(i)
-        out16 = pbuf[:self.n_local * 2].view(np.uint16)
-        self.opt.step_slot(i, g, lr=lr,
-                           grad_scale=grad_scale, out_bf16=out16)
-        self.param_store.release(i, dirty=True)
+        with trace_span("infinity/opt_layer", layer=i, mode="stream"):
+            if self.wire_bits:
+                g32 = np.empty(self.n_local, np.float32)
+                self._decode_wire(wire, g32, accumulate=False)
+                # the reported grad_norm must describe the grads actually
+                # APPLIED — the stochastically-rounded decode, not the
+                # pre-quantization device values (advisor r4, low)
+                self._layer_sq[i] = float(np.dot(g32, g32))
+                g = g32
+            else:
+                g = self._fetch_flat(wire).view(np.uint16)  # bf16 wire
+            self.opt.prefetch(i)
+            pbuf = self.param_store.acquire(i)
+            out16 = pbuf[:self.n_local * 2].view(np.uint16)
+            self.opt.step_slot(i, g, lr=lr,
+                               grad_scale=grad_scale, out_bf16=out16)
+            self.param_store.release(i, dirty=True)
 
     def _submit(self, i: int, fn, *args):
         """Dispatch a layer task to its pinned worker (i % N) — preserves
@@ -913,13 +915,14 @@ class InfinityStepper:
                                 grad_scale: float) -> None:
         """Worker-thread task: Adam over the accumulated fp32 grad row →
         bf16 emit into the param store slot; zero the row for next step."""
-        self.opt.prefetch(i)
-        pbuf = self.param_store.acquire(i)
-        out16 = pbuf[:self.n_local * 2].view(np.uint16)
-        self.opt.step_slot(i, self._grad_accum[i], lr=lr,
-                           grad_scale=grad_scale, out_bf16=out16)
-        self.param_store.release(i, dirty=True)
-        self._grad_accum[i] = 0.0
+        with trace_span("infinity/opt_layer", layer=i, mode="accum"):
+            self.opt.prefetch(i)
+            pbuf = self.param_store.acquire(i)
+            out16 = pbuf[:self.n_local * 2].view(np.uint16)
+            self.opt.step_slot(i, self._grad_accum[i], lr=lr,
+                               grad_scale=grad_scale, out_bf16=out16)
+            self.param_store.release(i, dirty=True)
+            self._grad_accum[i] = 0.0
 
     def _finish_layer(self, i: int, dflat, lr: float,
                       apply_scale: Optional[float]) -> None:
@@ -1022,8 +1025,9 @@ class InfinityStepper:
         # (slot_store.reclaim is gated to the stream thread), and a worker
         # needing a param-ring buffer would starve against our own pins.
         self._sweep_uploads(block=True)
-        for f in futures:
-            f.result()   # surface worker exceptions, join the sweep
+        with trace_span("infinity/worker_join", tasks=len(futures)):
+            for f in futures:
+                f.result()   # surface worker exceptions, join the sweep
         loss_total = sum(float(host_transfer(ls)) for ls, _, _ in
                          micro_stats)
         res_sq_total = sum(float(host_transfer(rs)) for _, rs, _ in
@@ -1085,11 +1089,12 @@ class InfinityStepper:
                 if np.isfinite(gnorm) and gnorm > self.clip:
                     grad_scale *= gnorm / self.clip
                 # clip-gated sweep, parallel across layers/cores
-                sweep = [self._submit(i, self._apply_layer_from_accum,
-                                      lr, grad_scale)
-                         for i in range(self.L)]
-                for f in sweep:
-                    f.result()
+                with trace_span("infinity/clip_sweep", layers=self.L):
+                    sweep = [self._submit(i, self._apply_layer_from_accum,
+                                          lr, grad_scale)
+                             for i in range(self.L)]
+                    for f in sweep:
+                        f.result()
         self._step_resident(res_acc, lr, grad_scale)
         self._dev.clear()   # device copies are stale after the sweep
         self._sweep_uploads(block=True)

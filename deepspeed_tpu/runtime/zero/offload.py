@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import ml_dtypes
 import numpy as np
 
+from ...observability import trace_span
 from ...utils.logging import logger
 from ..utils import host_transfer
 
@@ -148,10 +149,12 @@ class ZeroOffloadHostOptimizer:
         self.opt.step_count += 1
 
         def sweep(idxs, ghosts):
-            for k, gi in zip(idxs, ghosts):
-                self.opt.step_one(k, gi, lr=lr, grad_scale=grad_scale,
-                                  out_bf16=(self._bf16[k] if emit_bf16
-                                            else None))
+            # runs on the offload-opt worker thread — its own trace track
+            with trace_span("offload/sweep_bucket", leaves=len(idxs)):
+                for k, gi in zip(idxs, ghosts):
+                    self.opt.step_one(k, gi, lr=lr, grad_scale=grad_scale,
+                                      out_bf16=(self._bf16[k] if emit_bf16
+                                                else None))
             if emit_bf16:
                 return [self._bf16[k].view(ml_dtypes.bfloat16)
                         for k in idxs]
@@ -160,10 +163,11 @@ class ZeroOffloadHostOptimizer:
         new_leaves: List = [None] * len(self.opt.master)
 
         def upload(idxs, outs):
-            for k, o in zip(idxs, outs):
-                if upload_dtype is not None:
-                    o = o.astype(upload_dtype)
-                new_leaves[k] = jax.device_put(o, shardings[k])
+            with trace_span("offload/upload_bucket", leaves=len(idxs)):
+                for k, o in zip(idxs, outs):
+                    if upload_dtype is not None:
+                        o = o.astype(upload_dtype)
+                    new_leaves[k] = jax.device_put(o, shardings[k])
 
         if not hasattr(self, "_pool"):
             self._pool = ThreadPoolExecutor(max_workers=1,
@@ -174,7 +178,8 @@ class ZeroOffloadHostOptimizer:
                 return host_transfer(grad_dev_leaves[k])
         prev: Optional[tuple] = None
         for idxs in buckets:
-            ghosts = [fetch_fn(k) for k in idxs]
+            with trace_span("offload/fetch_bucket", leaves=len(idxs)):
+                ghosts = [fetch_fn(k) for k in idxs]
             fut = self._pool.submit(sweep, idxs, ghosts)
             if prev is not None:
                 # upload bucket i-1 on the main thread WHILE the worker

@@ -61,14 +61,35 @@ class SynchronizedWallClockTimer:
             self.timers[name] = _Timer(name)
         return self.timers[name]
 
+    @staticmethod
+    def memory_usage() -> str:
+        """Device + host memory snapshot (reference
+        SynchronizedWallClockTimer.memory_usage, timer.py). Host-side
+        reads only — ``memory_stats`` never blocks on the device."""
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            used = stats.get("bytes_in_use", 0) / 2**30
+            peak = stats.get("peak_bytes_in_use", 0) / 2**30
+        except Exception:
+            used = peak = 0.0
+        import resource
+        host_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2**20
+        return (f"device used {used:.2f}GB peak {peak:.2f}GB | "
+                f"host rss {host_gb:.2f}GB")
+
     def log(self, names: List[str], normalizer: float = 1.0,
             reset: bool = True, memory_breakdown=None) -> str:
+        """Log elapsed ms per named timer; ``memory_breakdown`` (the
+        ``memory_breakdown`` config key) appends the memory snapshot."""
         parts = []
         for name in names:
             if name in self.timers:
                 ms = self.timers[name].elapsed(reset) * 1000.0 / normalizer
                 parts.append(f"{name}: {ms:.2f}ms")
         line = " | ".join(parts)
+        if memory_breakdown:
+            line = f"{line} | {self.memory_usage()}" if line \
+                else self.memory_usage()
         if line:
             logger.info(f"time (ms) | {line}")
         return line
@@ -76,17 +97,24 @@ class SynchronizedWallClockTimer:
 
 class ThroughputTimer:
     """Samples/sec + tokens/sec over a sliding window of steps (reference
-    ThroughputTimer: batch-size-aware, skips warmup steps)."""
+    ThroughputTimer: batch-size-aware, skips warmup steps).
+
+    ``steps_per_output`` > 0 emits a throughput summary every N steps —
+    logged, and handed to ``event_fn(summary_dict, step)`` when set (the
+    hook a caller uses to route summaries into a monitor backend)."""
 
     def __init__(self, batch_size: int, seq_length: int = 0,
-                 start_step: int = 2, steps_per_output: int = 0):
+                 start_step: int = 2, steps_per_output: int = 0,
+                 event_fn=None):
         self.batch_size = batch_size
         self.seq_length = seq_length
         self.start_step = start_step
         self.steps_per_output = steps_per_output
+        self.event_fn = event_fn
         self.step_count = 0
         self.total_elapsed = 0.0
         self.timed_steps = 0
+        self.last_step_time: Optional[float] = None
         self._t0: Optional[float] = None
 
     def start(self) -> None:
@@ -100,9 +128,26 @@ class ThroughputTimer:
         dt = time.perf_counter() - self._t0
         self._t0 = None
         self.step_count += 1
+        self.last_step_time = dt
         if self.step_count > self.start_step:   # skip compile/warmup steps
             self.total_elapsed += dt
             self.timed_steps += 1
+        if self.steps_per_output and \
+                self.step_count % self.steps_per_output == 0 and \
+                self.timed_steps > 0:
+            self._emit_summary()
+
+    def _emit_summary(self) -> None:
+        s = self.summary()
+        line = (f"throughput @ step {self.step_count}: "
+                f"{s['samples_per_sec']:.1f} samples/s")
+        if self.seq_length:
+            line += f", {s['tokens_per_sec']:,.0f} tok/s"
+        line += (f", {s['avg_step_time_s'] * 1e3:.1f} ms/step "
+                 f"over {self.timed_steps} timed steps")
+        logger.info(line)
+        if self.event_fn is not None:
+            self.event_fn(s, self.step_count)
 
     @property
     def avg_step_time(self) -> float:

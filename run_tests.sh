@@ -38,8 +38,8 @@ for f in tests/unit/test_*.py; do
   if [[ -n "$FILTER" && "$f" != *"$FILTER"* ]]; then
     continue
   fi
-  if [[ "$f" == *test_resilience.py ]]; then
-    continue   # runs once in the marker sweep below, not twice
+  if [[ "$f" == *test_resilience.py || "$f" == *test_observability.py ]]; then
+    continue   # each runs once in its marker sweep below, not twice
   fi
   echo "=== $f"
   if python -m pytest "$f" -q --tb=short ${EXTRA_PYTEST_ARGS:-}; then
@@ -60,6 +60,19 @@ if [[ -z "$FILTER" || "resilience" == *"$FILTER"* ]]; then
     PASSED=$((PASSED + 1))
   else
     FAILED+=("pytest -m resilience")
+  fi
+fi
+
+# Observability sweep: tracer/metrics/exporter tests plus the end-to-end
+# "train loop → Perfetto trace + Prometheus textfile" integration test
+# (pytest.ini `observability` marker; docs/observability.md).
+if [[ -z "$FILTER" || "observability" == *"$FILTER"* ]]; then
+  echo "=== observability marker sweep (pytest -m observability)"
+  if JAX_PLATFORMS=cpu python -m pytest tests/unit/test_observability.py \
+       -m observability -q --tb=short ${EXTRA_PYTEST_ARGS:-}; then
+    PASSED=$((PASSED + 1))
+  else
+    FAILED+=("pytest -m observability")
   fi
 fi
 

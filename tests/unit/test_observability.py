@@ -1,0 +1,466 @@
+"""Observability suite: span tracer, metrics registry, exporters, and the
+instrumented training loop (deepspeed_tpu/observability/,
+docs/observability.md).
+
+The integration test pins the PR's acceptance contract: a CPU-backend
+training loop with the ``observability`` block enabled produces a
+Perfetto-loadable Chrome trace with spans from ≥4 subsystems plus a
+Prometheus textfile carrying the step-time histogram and resilience
+counters; with the block disabled the span path is a shared no-op.
+"""
+import json
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu import observability as obs
+from deepspeed_tpu.observability.metrics import (MetricsRegistry,
+                                                 sanitize_name)
+from deepspeed_tpu.observability.tracer import NULL_SPAN, SpanTracer
+from deepspeed_tpu.models import TransformerLM, gpt2_config
+
+pytestmark = pytest.mark.observability
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+class TestSpanTracer:
+    def test_disabled_path_is_shared_noop(self):
+        tr = SpanTracer(capacity=16)
+        s1 = tr.span("a/b")
+        s2 = tr.span("c/d", attr=1)
+        # no span objects allocated when off: the SAME singleton each time
+        assert s1 is NULL_SPAN and s2 is NULL_SPAN
+        with s1:
+            s1.set(x=1)
+        assert tr.recorded == 0 and tr.dropped == 0
+
+    def test_module_trace_span_disabled_identity(self):
+        obs.get_tracer().configure(enabled=False)
+        assert obs.trace_span("x/y") is NULL_SPAN
+
+    def test_records_and_ring_wraparound(self, tmp_path):
+        tr = SpanTracer()
+        tr.configure(enabled=True, capacity=8, output_dir=str(tmp_path))
+        for i in range(20):
+            with tr.span("t/span", i=i):
+                pass
+        assert tr.recorded == 8
+        assert tr.dropped == 12
+        path = tr.flush()
+        with open(path) as f:
+            doc = json.load(f)
+        xev = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(xev) == 8
+        # oldest spans were overwritten: only i=12..19 survive, in order
+        assert [e["args"]["i"] for e in xev] == list(range(12, 20))
+        assert doc["otherData"]["dropped_spans"] == 12
+
+    def test_chrome_trace_schema(self, tmp_path):
+        """The exported JSON validates against the Chrome trace-event
+        contract Perfetto requires: X events with name/ph/pid/tid/ts/dur,
+        M metadata for process and thread names."""
+        tr = SpanTracer()
+        tr.configure(enabled=True, capacity=32, output_dir=str(tmp_path),
+                     rank=3)
+        with tr.span("outer/span", step=1):
+            with tr.span("inner/span"):
+                pass
+        path = tr.flush()
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["displayTimeUnit"] == "ms"
+        events = doc["traceEvents"]
+        xev = [e for e in events if e["ph"] == "X"]
+        assert {e["name"] for e in xev} == {"outer/span", "inner/span"}
+        for e in xev:
+            for key in ("name", "ph", "pid", "tid", "ts", "dur"):
+                assert key in e, f"missing {key} in {e}"
+            assert e["pid"] == 3
+            assert e["ts"] >= 0 and e["dur"] >= 0
+        # inner committed first (exit order), nested inside outer's window
+        inner = next(e for e in xev if e["name"] == "inner/span")
+        outer = next(e for e in xev if e["name"] == "outer/span")
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1
+        meta = {e["name"] for e in events if e["ph"] == "M"}
+        assert "process_name" in meta and "thread_name" in meta
+
+    def test_thread_tracks(self, tmp_path):
+        import threading
+        tr = SpanTracer()
+        tr.configure(enabled=True, capacity=32, output_dir=str(tmp_path))
+
+        def work():
+            with tr.span("w/span"):
+                pass
+        t = threading.Thread(target=work, name="swap-worker-0")
+        t.start()
+        t.join()
+        with tr.span("m/span"):
+            pass
+        with open(tr.flush()) as f:
+            doc = json.load(f)
+        thread_names = {e["args"]["name"] for e in doc["traceEvents"]
+                        if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert "swap-worker-0" in thread_names
+        tids = {e["tid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+        assert len(tids) == 2   # two tracks
+
+    def test_flush_sync_routes_host_transfer(self, tmp_path):
+        tr = SpanTracer()
+        tr.configure(enabled=True, capacity=4, output_dir=str(tmp_path))
+        with tr.span("s/x"):
+            pass
+        # device value joined at the flush boundary (host_transfer path)
+        path = tr.flush(sync=jnp.ones(()))
+        assert os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+class TestMetricsRegistry:
+    def test_counter_gauge_types(self):
+        reg = MetricsRegistry()
+        c = reg.counter("c_total", help="h")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        assert reg.counter("c_total") is c      # get-or-create
+        g = reg.gauge("g_now")
+        g.set(7.0)
+        assert g.value == 7.0
+        with pytest.raises(TypeError):
+            reg.gauge("c_total")                # kind mismatch
+
+    def test_histogram_buckets(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h_seconds", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        cum = dict(h.cumulative())
+        assert cum[0.1] == 1 and cum[1.0] == 3 and cum[10.0] == 4
+        assert cum[math.inf] == 5
+        assert h.count == 5
+        assert h.sum == pytest.approx(56.05)
+        assert h.value == pytest.approx(56.05 / 5)
+
+    def test_prometheus_export_roundtrip(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("dstpu_x_total", help="things").inc(4)
+        h = reg.histogram("dstpu_t_seconds", buckets=(1.0, 2.0))
+        h.observe(1.5)
+        path = reg.export_prometheus(str(tmp_path / "m.prom"))
+        text = open(path).read()
+        assert "# TYPE dstpu_x_total counter" in text
+        assert "dstpu_x_total 4.0" in text
+        assert 'dstpu_t_seconds_bucket{le="1.0"} 0' in text
+        assert 'dstpu_t_seconds_bucket{le="2.0"} 1' in text
+        assert 'dstpu_t_seconds_bucket{le="+Inf"} 1' in text
+        assert "dstpu_t_seconds_count 1" in text
+
+    def test_json_export_roundtrip(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.gauge("depth").set(3.0)
+        reg.histogram("lat", buckets=(1.0,)).observe(0.5)
+        path = reg.export_json(str(tmp_path / "m.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["depth"] == {"kind": "gauge", "value": 3.0}
+        assert doc["lat"]["count"] == 1
+        assert doc["lat"]["buckets"][-1][0] == "+Inf"
+
+    def test_to_events_for_monitor(self):
+        reg = MetricsRegistry()
+        reg.counter("a_total").inc(2)
+        reg.gauge("b").set(1.5)
+        events = reg.to_events(step=7)
+        assert ("Metrics/a_total", 2.0, 7) in events
+        assert ("Metrics/b", 1.5, 7) in events
+
+    def test_collectors_keyed_replacement(self):
+        reg = MetricsRegistry()
+        calls = []
+        reg.set_collector("engine", lambda: calls.append("old"))
+        reg.set_collector("engine", lambda: calls.append("new"))
+        reg.collect()
+        assert calls == ["new"]       # re-registering replaced, not stacked
+
+    def test_sanitize_name(self):
+        assert sanitize_name("zero/nvme_write") == "zero_nvme_write"
+        assert sanitize_name("1bad") == "_1bad"
+
+
+# ---------------------------------------------------------------------------
+# config block
+# ---------------------------------------------------------------------------
+class TestObservabilityConfig:
+    def test_defaults_off(self):
+        cfg = ds.DeepSpeedConfig({"train_batch_size": 8})
+        assert not cfg.observability.enabled
+        assert not cfg.observability.tracing.enabled
+        assert not cfg.observability.metrics.enabled
+        assert cfg.observability.tracing.buffer_size == 65536
+
+    def test_parse_enabled(self):
+        cfg = ds.DeepSpeedConfig({
+            "train_batch_size": 8,
+            "observability": {
+                "tracing": {"enabled": True, "buffer_size": 128,
+                            "output_dir": "/tmp/t"},
+                "metrics": {"enabled": True, "prometheus_dir": "/tmp/p",
+                            "export_interval_steps": 5}}})
+        o = cfg.observability
+        assert o.enabled and o.tracing.enabled and o.metrics.enabled
+        assert o.tracing.buffer_size == 128
+        assert o.metrics.export_interval_steps == 5
+
+    def test_rejects_bad_values(self):
+        with pytest.raises(Exception):
+            ds.DeepSpeedConfig({"train_batch_size": 8, "observability": {
+                "tracing": {"buffer_size": 0}}})
+        with pytest.raises(Exception):
+            ds.DeepSpeedConfig({"train_batch_size": 8, "observability": {
+                "metrics": {"export_interval_steps": -1}}})
+        with pytest.raises(Exception):   # typo'd key rejected
+            ds.DeepSpeedConfig({"train_batch_size": 8, "observability": {
+                "tracing": {"enabld": True}}})
+
+
+# ---------------------------------------------------------------------------
+# comms busbw columns (satellite: calc_bw_factor was dead code)
+# ---------------------------------------------------------------------------
+class TestCommsBw:
+    def test_all_reduce_factor_pinned(self):
+        from deepspeed_tpu.comm.comms_logging import calc_bw_factor
+        for n in (2, 4, 8, 64):
+            assert calc_bw_factor("all_reduce", n) == \
+                pytest.approx(2 * (n - 1) / n)
+        for op in ("all_gather", "reduce_scatter", "all_to_all"):
+            assert calc_bw_factor(op, 8) == pytest.approx(7 / 8)
+        assert calc_bw_factor("broadcast", 8) == 1.0
+        assert calc_bw_factor("all_reduce", 1) == 0.0   # no wire traffic
+
+    def test_log_summary_wire_volume_columns(self):
+        from deepspeed_tpu.comm.comms_logging import CommsLogger
+        cl = CommsLogger()
+        cl.configure(enabled=True)
+        for _ in range(3):
+            cl.record("all_reduce", 1024, "data", n=4)
+        out = cl.log_summary()
+        assert "BW factor" in out and "Wire volume" in out
+        row = next(l for l in out.splitlines() if l.startswith("all_reduce"))
+        assert "1.500" in row                      # 2(n-1)/n at n=4
+        assert str(int(3 * 1024 * 1.5)) in row     # wire volume column
+
+    def test_record_without_n_reports_zero_factor(self):
+        from deepspeed_tpu.comm.comms_logging import CommsLogger
+        cl = CommsLogger()
+        cl.configure(enabled=True)
+        cl.record("all_reduce", 512, "data")       # n unknown
+        row = next(l for l in cl.log_summary().splitlines()
+                   if l.startswith("all_reduce"))
+        assert "0.000" in row
+
+    def test_axis_size_captured_at_trace_time(self, mesh8):
+        """The WIRING, not just the formula: tracing a collective through
+        deepspeed_tpu.comm records the axis size, so log_summary's wire
+        volume is non-zero in production (jax 0.4.x has no
+        lax.axis_size — the psum(1) fallback must carry it)."""
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        from deepspeed_tpu.comm import comm
+        from deepspeed_tpu.comm.comms_logging import (configure,
+                                                      get_comms_logger)
+        configure(verbose=False)
+        cl = get_comms_logger()
+        cl.reset()
+
+        def f(x):
+            return comm.all_reduce(x, axis_name="data")
+        with mesh8:
+            jax.jit(shard_map(f, mesh=mesh8, in_specs=P("data"),
+                              out_specs=P()))(
+                np.arange(8, dtype=np.float32))
+        recs = cl.comms_dict["all_reduce"]
+        assert recs, "collective was not recorded at trace time"
+        rec = next(iter(recs.values()))
+        assert rec.get("n") == 8       # axis size captured, not 0
+        row = next(l for l in cl.log_summary().splitlines()
+                   if l.startswith("all_reduce"))
+        assert "1.750" in row          # 2(n-1)/n at n=8
+        cl.reset()
+
+
+# ---------------------------------------------------------------------------
+# timer satellites
+# ---------------------------------------------------------------------------
+class TestTimerSatellites:
+    def test_throughput_steps_per_output_emits(self, caplog):
+        from deepspeed_tpu.utils.timer import ThroughputTimer
+        got = []
+        t = ThroughputTimer(batch_size=4, seq_length=16, start_step=1,
+                            steps_per_output=3,
+                            event_fn=lambda s, step: got.append((s, step)))
+        for _ in range(7):
+            t.start()
+            t.stop()
+        # emissions at steps 3 and 6 (timed_steps > 0 from step 2 on)
+        assert [step for _, step in got] == [3, 6]
+        s = got[-1][0]
+        assert {"avg_step_time_s", "samples_per_sec",
+                "tokens_per_sec"} <= set(s)
+        assert t.last_step_time is not None and t.last_step_time >= 0
+
+    def test_wallclock_log_memory_breakdown(self):
+        from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+        timers = SynchronizedWallClockTimer()
+        timers("phase").start()
+        timers("phase").stop()
+        line = timers.log(["phase"], memory_breakdown=True)
+        assert "phase:" in line
+        assert "host rss" in line     # the memory snapshot rode the line
+        plain = SynchronizedWallClockTimer()
+        plain("p").start()
+        plain("p").stop()
+        assert "host rss" not in plain.log(["p"])
+
+
+# ---------------------------------------------------------------------------
+# wandb event batching (satellite)
+# ---------------------------------------------------------------------------
+class TestWandbBatching:
+    def test_events_batched_per_step(self):
+        from deepspeed_tpu.monitor.monitor import WandbMonitor
+
+        class FakeWandb:
+            def __init__(self):
+                self.calls = []
+
+            def log(self, payload, step=None):
+                self.calls.append((dict(payload), step))
+
+        mon = WandbMonitor.__new__(WandbMonitor)
+        mon.enabled = True
+        mon._wandb = FakeWandb()
+        mon.write_events([("Train/loss", 1.0, 5), ("Train/lr", 0.1, 5),
+                          ("Train/loss", 0.9, 6)])
+        # one wandb.log per STEP, not per event — no step-clobbering
+        assert mon._wandb.calls == [
+            ({"Train/loss": 1.0, "Train/lr": 0.1}, 5),
+            ({"Train/loss": 0.9}, 6)]
+
+
+# ---------------------------------------------------------------------------
+# integration: instrumented training loop (acceptance criteria)
+# ---------------------------------------------------------------------------
+def tiny_model(num_layers=2):
+    cfg = gpt2_config("125m", num_layers=num_layers, d_model=32,
+                      num_heads=4, vocab_size=64, max_seq_len=16,
+                      dtype=jnp.float32)
+    return TransformerLM(cfg)
+
+
+def batch(n, seed=0):
+    rs = np.random.RandomState(seed)
+    return {"input_ids": rs.randint(0, 64, (n, 16), dtype=np.int32)}
+
+
+class TestIntegration:
+    def test_training_loop_produces_trace_and_textfile(self, tmp_path):
+        """Acceptance: CPU-backend loop with tracing+metrics on → Chrome
+        trace with spans from ≥4 subsystems (engine step phases,
+        zero/offload I/O, checkpoint, comm) + Prometheus textfile with
+        the step-time histogram and resilience counters."""
+        config = {
+            "train_batch_size": 16, "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "mesh": {"data": 8}, "steps_per_print": 0,
+            "zero_optimization": {
+                "offload_optimizer": {"device": "cpu"}},
+            "observability": {
+                "tracing": {"enabled": True,
+                            "output_dir": str(tmp_path / "traces")},
+                "metrics": {"enabled": True,
+                            "prometheus_dir": str(tmp_path / "prom"),
+                            "json_path": str(tmp_path / "metrics.json")}},
+        }
+        engine, _, _, _ = ds.initialize(model=tiny_model(), config=config)
+        for i in range(3):
+            engine.train_step(batch(16, seed=i))
+        engine.save_checkpoint(str(tmp_path / "ckpt"))
+        ds.comm.comm.barrier()
+        paths = engine.flush_observability()
+        trace_path = tmp_path / "traces" / "trace_rank0.json"
+        assert str(trace_path) in paths
+        with open(trace_path) as f:
+            doc = json.load(f)
+        names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+        subsystems = {n.split("/")[0] for n in names}
+        assert {"engine", "offload", "checkpoint",
+                "comm"} <= subsystems, subsystems
+        assert "engine/train_step" in names
+        assert "offload/grads" in names and "offload/host_sweep" in names
+        assert "checkpoint/publish" in names
+        assert "comm/barrier" in names
+
+        prom = open(tmp_path / "prom" / "dstpu_rank0.prom").read()
+        # step-time histogram, fed at the synced GAS boundary
+        assert "# TYPE dstpu_step_time_seconds histogram" in prom
+        count_line = next(l for l in prom.splitlines()
+                          if l.startswith("dstpu_step_time_seconds_count"))
+        assert int(count_line.split()[-1]) >= 3
+        # resilience counters are present even at zero (pre-registered)
+        assert "dstpu_io_retries_total" in prom
+        assert "dstpu_train_skipped_steps_total" in prom
+        # the jit recompile watermark moved when programs were built
+        jit_line = next(l for l in prom.splitlines()
+                        if l.startswith("dstpu_jit_programs_built_total"))
+        assert float(jit_line.split()[-1]) >= 1
+
+        with open(tmp_path / "metrics.json") as f:
+            snap = json.load(f)
+        assert snap["dstpu_step_time_seconds"]["count"] >= 3
+
+    def test_metrics_flow_into_monitor_fanout(self, tmp_path):
+        """Registry scalars ride MonitorMaster: the CSV backend grows
+        Metrics_* files without any backend-specific wiring."""
+        config = {
+            "train_batch_size": 16, "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "mesh": {"data": 8}, "steps_per_print": 0,
+            "csv_monitor": {"enabled": True,
+                            "output_path": str(tmp_path),
+                            "job_name": "obsjob"},
+            "observability": {"metrics": {"enabled": True}},
+        }
+        engine, _, _, _ = ds.initialize(model=tiny_model(), config=config)
+        for i in range(2):
+            engine.train_step(batch(16, seed=i))
+        engine.monitor.flush()
+        files = os.listdir(tmp_path / "obsjob")
+        assert "Metrics_dstpu_train_steps_total.csv" in files
+        assert "Metrics_dstpu_step_time_seconds.csv" in files
+
+    def test_disabled_block_is_noop(self, tmp_path):
+        """With the block absent the tracer is off, trace_span returns
+        the shared null singleton, and no telemetry files appear."""
+        config = {
+            "train_batch_size": 16, "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "mesh": {"data": 8}, "steps_per_print": 0,
+        }
+        engine, _, _, _ = ds.initialize(model=tiny_model(), config=config)
+        assert not engine._tracer.enabled
+        assert obs.trace_span("engine/train_step") is NULL_SPAN
+        before = engine._tracer.recorded
+        engine.train_step(batch(16))
+        assert engine._tracer.recorded == before   # nothing recorded
+        assert engine.flush_observability() == []  # nothing exported
