@@ -71,8 +71,108 @@ def decode_bench(size: str = "125m", batch: int = 4, prompt: int = 64,
         "metric": f"gpt2_{size}_decode_p50_ms_per_token",
         "value": round(stats["p50_ms"], 3), "unit": "ms",
         "p90_ms": round(stats["p90_ms"], 3),
+        # decode-only since PR 4 (prefill now reported as TTFT instead
+        # of being amortized into the per-token number)
+        "ttft_p50_ms": round(stats["ttft_p50_ms"], 3),
         "decode_tokens_per_sec": round(stats["tokens_per_sec"], 1)}),
         flush=True)
+
+
+def serving_decode_bench(size: str = "125m", slots: int = 8,
+                         prompt: int = 128, new: int = 128):
+    """Continuous-batching serving throughput (inference/serving/):
+    `slots` concurrent streams through the single-trace batched decode
+    step + paged KV pool, vs the single-stream decode baseline the
+    `gpt2_*_decode_p50_ms_per_token` metric tracks."""
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+
+    cfg = gpt2_config(size, max_seq_len=prompt + new, attn_impl="flash",
+                      dtype=jnp.bfloat16)
+    block = 32
+    eng = ds.init_inference(TransformerLM(cfg), config={
+        "dtype": "bfloat16", "max_out_tokens": prompt + new,
+        "temperature": 0.0,
+        "serving": {"enabled": True, "kv_block_size": block,
+                    "num_kv_blocks":
+                        slots * ((prompt + new) // block + 1) + 8,
+                    "max_batch_slots": slots}})
+    srv = eng.serving_engine()
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, cfg.vocab_size, (prompt,)).tolist()
+               for _ in range(2 * slots)]
+    # warm the compiled programs (prefill bucket + decode step)
+    srv.submit(prompts[0], max_new_tokens=4)
+    srv.run(max_steps=50)
+    itl = srv._m_itl            # decode-iteration wall-time histogram
+    warm_sum, warm_n = itl.sum, itl.count   # exclude warmup+compile iters
+    t0 = time.perf_counter()
+    reqs = [srv.submit(p, max_new_tokens=new) for p in prompts]
+    srv.run(max_steps=100 * len(prompts) * new)
+    dt = time.perf_counter() - t0
+    toks = sum(len(r.output) for r in reqs)
+    iter_ms = ((itl.sum - warm_sum) / max(itl.count - warm_n, 1)) * 1e3
+    print(json.dumps({
+        "metric": "decode_batched_tokens_per_sec",
+        "value": round(toks / dt, 1), "unit": "tokens/s",
+        "slots": slots, "requests": len(prompts),
+        "prompt": prompt, "new": new,
+        "decode_iter_mean_ms": round(iter_ms, 3),
+        "preemptions": srv.scheduler.preemption_count,
+        "decode_builds": srv.decode_builds}), flush=True)
+
+
+def paged_decode_attention_bench(slots: int = 8, heads: int = 16,
+                                 d: int = 128, cache: int = 16384,
+                                 block: int = 256, iters: int = 20):
+    """Batched paged decode-attention kernel at serving shapes: `slots`
+    ragged sequences (cache/2 .. cache tokens) through one kernel
+    dispatch. Achieved GB/s counts only the VALID kv bytes each slot
+    actually attends — the block tables mean padding is never read."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.transformer.paged_decode_attention import (
+        paged_decode_attention)
+
+    rs = np.random.RandomState(0)
+    pages = cache // block
+    nb = slots * pages + 1
+    lens = np.linspace(cache // 2, cache, slots).astype(np.int32)
+    bt = np.zeros((slots, pages), np.int32)
+    free = 1
+    for i, ln in enumerate(lens):
+        n = -(-int(ln) // block)
+        bt[i, :n] = np.arange(free, free + n)
+        free += n
+    q = jnp.asarray(rs.randn(slots, heads, d), jnp.bfloat16)
+    pk = jnp.asarray(rs.randn(nb, block, heads, d), jnp.bfloat16)
+    pv = jnp.asarray(rs.randn(nb, block, heads, d), jnp.bfloat16)
+    lens_j = jnp.asarray(lens)
+    bt_j = jnp.asarray(bt)
+    # pools ride as ARGUMENTS (closing over them would bake ~GiB of pool
+    # data into the executable as constants — decode16k_bench ditto)
+    f = jax.jit(lambda q, pk, pv: paged_decode_attention(q, pk, pv,
+                                                         lens_j, bt_j))
+    o = f(q, pk, pv)
+    o.block_until_ready()
+    qq = q
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        # roll q each dispatch: additive eps-perturbations underflow in
+        # bf16 (bit-identical input → the tunnel elides the dispatch,
+        # the r3 chain flaw) — same discipline as blocksparse_bench
+        qq = jnp.roll(qq, 1, axis=1)
+        o = f(qq, pk, pv)
+    o.block_until_ready()
+    ms = (time.perf_counter() - t0) / iters * 1000
+    valid_gb = float(lens.sum()) * heads * d * 2 * 2 / 2**30
+    print(json.dumps({
+        "metric": "decode_attention_batched_gbps",
+        "value": round(valid_gb / (ms / 1000), 1), "unit": "GB/s",
+        "ms": round(ms, 3), "slots": slots,
+        "valid_kv_gib": round(valid_gb, 2),
+        "cache_tokens": [int(x) for x in lens]}), flush=True)
 
 
 def decode16k_bench(batch: int = 4, heads: int = 16, d: int = 128,
@@ -436,6 +536,8 @@ def main():
         train_bench("350m", 16, 1024, 3, iters=6)
         decode_bench()
         decode16k_bench()
+        serving_decode_bench()
+        paged_decode_attention_bench()
         blocksparse_bench()
         diffusion_bench()
         host_offload_bench()

@@ -10,9 +10,44 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
-from pydantic import Field
+from pydantic import Field, model_validator
 
+from ..runtime import constants as C
 from ..runtime.config_utils import ConfigModel
+
+
+class ServingConfig(ConfigModel):
+    """``serving`` block — continuous-batching inference
+    (`inference/serving/`, docs/serving.md).
+
+    The KV workspace becomes one shared pool of ``num_kv_blocks`` fixed
+    ``kv_block_size``-token blocks (block 0 reserved as the null
+    block), and the decode step becomes a single compiled program over
+    ``max_batch_slots`` slots that requests join and leave between
+    iterations.  Pool sizing rule of thumb: concurrent tokens =
+    (num_kv_blocks - 1) * kv_block_size must cover the target batch's
+    prompts + generations or the scheduler will (correctly) queue and
+    preempt."""
+    enabled: bool = False
+    kv_block_size: int = C.SERVING_KV_BLOCK_SIZE_DEFAULT
+    num_kv_blocks: int = C.SERVING_NUM_KV_BLOCKS_DEFAULT
+    max_batch_slots: int = C.SERVING_MAX_BATCH_SLOTS_DEFAULT
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.kv_block_size < 1:
+            raise ValueError(
+                f"serving.kv_block_size must be >= 1, got "
+                f"{self.kv_block_size}")
+        if self.num_kv_blocks < 2:
+            raise ValueError(
+                f"serving.num_kv_blocks must be >= 2 (block 0 is the "
+                f"reserved null block), got {self.num_kv_blocks}")
+        if self.max_batch_slots < 1:
+            raise ValueError(
+                f"serving.max_batch_slots must be >= 1, got "
+                f"{self.max_batch_slots}")
+        return self
 
 
 class TensorParallelConfig(ConfigModel):
@@ -44,6 +79,9 @@ class DeepSpeedInferenceConfig(ConfigModel):
         default_factory=TensorParallelConfig)
     moe: MoEInferenceConfig = Field(default_factory=MoEInferenceConfig)
     quant: QuantConfig = Field(default_factory=QuantConfig)
+    # continuous-batching serving layer (inference/serving/,
+    # docs/serving.md): paged KV pool + iteration-level scheduler
+    serving: ServingConfig = Field(default_factory=ServingConfig)
     # KV workspace sizing (reference inference_context.h: max_out_tokens
     # bounds the preallocated cache)
     max_out_tokens: int = 1024

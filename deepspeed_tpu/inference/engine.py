@@ -116,7 +116,9 @@ class InferenceEngine:
 
         self._fwd = None
         self._gen_fns: Dict[Tuple, Any] = {}
-        self._latencies: list = []
+        self._latencies: list = []      # per-token DECODE-only seconds
+        self._ttfts: list = []          # prefill -> first-token seconds
+        self._serving = None
         # model-time profiling (reference inference/engine.py:159
         # profile_model_time / :503 model_times): disabled until enabled,
         # then every forward/generate call appends its synced wall time
@@ -308,13 +310,15 @@ class InferenceEngine:
         return out
 
     def _guarded_sync(self, out) -> bool:
-        """block_until_ready under the profile timeout guard. True iff
-        the sync completed (sample is valid)."""
+        """Deliberate device sync (any pytree) under the profile timeout
+        guard. True iff the sync completed (sample is valid)."""
+        from ..runtime.utils import host_transfer
         timeout = self.config.profile_sync_timeout_s
         if timeout <= 0:
-            out.block_until_ready()
+            host_transfer(out, block=True)
             return True
-        if run_with_timeout(out.block_until_ready, timeout):
+        if run_with_timeout(lambda: host_transfer(out, block=True),
+                            timeout):
             return True
         logger.error(
             f"device sync did not complete within {timeout:.0f}s — "
@@ -378,6 +382,13 @@ class InferenceEngine:
     def _build_generate(self, batch: int, prompt_len: int, max_new: int,
                         temperature: float, top_k: int, top_p: float,
                         eos_token_id: Optional[int]):
+        """Two programs, split at the first token: ``prefill`` (prompt
+        forward + first sample) and ``decode`` (the scan over the
+        remaining ``max_new - 1`` tokens).  The split is what lets
+        ``latency_stats`` report TTFT and per-token decode latency as
+        the separate quantities they are — one fused program could only
+        report their blur (the pre-PR-4 per-token number divided prefill
+        time across decode tokens)."""
         model = self.module
         cache_len = prompt_len + max_new
         if cache_len > self.config.max_out_tokens:
@@ -390,10 +401,10 @@ class InferenceEngine:
                 f"({self.config.max_batch_size}) — raise it in the config "
                 f"(it bounds the KV workspace, reference inference_context.h)")
 
-        def gen(params, scales, ids, true_len, rng):
+        def prefill(params, scales, ids, true_len, rng):
             params = self._model_params(params, scales)
             cache = model.init_cache(batch, cache_len, dtype=self.dtype)
-            logits, cache = model.apply(params, ids, cache=cache)  # prefill
+            logits, cache = model.apply(params, ids, cache=cache)
             # bucketing: ids are right-padded to the bucket; the padded
             # positions' cache slots are dropped by resetting the index to
             # the true length (decode overwrites them; the valid mask
@@ -406,6 +417,10 @@ class InferenceEngine:
             tok = self._sample(last, sub, temperature, top_k, top_p)
             done = (jnp.zeros((batch,), jnp.bool_) if eos_token_id is None
                     else tok == eos_token_id)
+            return cache, tok, rng, done
+
+        def decode(params, scales, cache, tok, rng, done):
+            params = self._model_params(params, scales)
 
             def step(carry, _):
                 cache, tok, rng, done = carry
@@ -424,7 +439,11 @@ class InferenceEngine:
                 [toks.swapaxes(0, 1), last[:, None]], axis=1)
 
         with self.mesh:
-            return jax.jit(gen)
+            # the decode program consumes the prefill state exactly once —
+            # donating it keeps the KV cache in place between the two
+            # programs (CPU backend implements no donation and would warn)
+            donate = (2, 3) if jax.default_backend() == "tpu" else ()
+            return jax.jit(prefill), jax.jit(decode, donate_argnums=donate)
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: Optional[float] = None,
@@ -463,29 +482,67 @@ class InferenceEngine:
         compiled_now = key not in self._gen_fns
         if compiled_now:
             self._gen_fns[key] = self._build_generate(*key)
+        prefill_fn, decode_fn = self._gen_fns[key]
+        scales = getattr(self, "_scales", None)
+        # TTFT: prompt forward + first token, synced at the split point
         t0 = time.perf_counter()
-        out = self._gen_fns[key](self.params, getattr(self, "_scales", None),
-                                 ids, jnp.asarray(true_len, jnp.int32),
-                                 rng if rng is not None
-                                 else jax.random.PRNGKey(0))
+        state = prefill_fn(self.params, scales, ids,
+                           jnp.asarray(true_len, jnp.int32),
+                           rng if rng is not None
+                           else jax.random.PRNGKey(0))
         if self.model_profile_enabled:
-            synced = self._guarded_sync(out)
+            synced = self._guarded_sync(state)
+        else:
+            jax.block_until_ready(state)
+            synced = True
+        t1 = time.perf_counter()
+        out = decode_fn(self.params, scales, *state)
+        if self.model_profile_enabled:
+            synced = self._guarded_sync(out) and synced
         else:
             out.block_until_ready()
-            synced = True
-        dt = time.perf_counter() - t0
+        t2 = time.perf_counter()
         if synced:
-            self._latencies.append(dt / max(max_new_tokens, 1))
+            self._ttfts.append(t1 - t0)
+            # decode-only per-token latency: the prefill cost lives in
+            # TTFT, not amortized into the decode number
+            self._latencies.append((t2 - t1) / max(max_new_tokens - 1, 1))
             if self.model_profile_enabled and not compiled_now:
-                self._model_times.append(dt)
+                self._model_times.append(t2 - t0)
         return out
 
     def latency_stats(self) -> Dict[str, float]:
-        """p50/p90 per-token decode latency over calls so far (reference
-        `benchmarks/inference/gpt-bench.py` reporting)."""
+        """Decode and first-token latency over ``generate`` calls so far
+        (reference `benchmarks/inference/gpt-bench.py` reporting).
+
+        ``p50_ms``/``p90_ms``/``tokens_per_sec`` are DECODE-ONLY
+        per-token numbers (prefill excluded); ``ttft_p50_ms``/
+        ``ttft_p90_ms`` report prompt-to-first-token separately.  The
+        pre-PR-4 number divided whole-call wall time (prefill included)
+        by ``max_new_tokens``, which overstated decode latency exactly
+        when prompts were long."""
         if not self._latencies:
             return {}
         lat = np.asarray(self._latencies[1:] or self._latencies)  # drop compile
+        ttft = np.asarray(self._ttfts[1:] or self._ttfts)
         return {"p50_ms": float(np.percentile(lat, 50) * 1e3),
                 "p90_ms": float(np.percentile(lat, 90) * 1e3),
-                "tokens_per_sec": float(1.0 / np.mean(lat))}
+                "tokens_per_sec": float(1.0 / np.mean(lat)),
+                "ttft_p50_ms": float(np.percentile(ttft, 50) * 1e3),
+                "ttft_p90_ms": float(np.percentile(ttft, 90) * 1e3)}
+
+    # ------------------------------------------------------------------
+    # continuous-batching serving (inference/serving/, docs/serving.md)
+    # ------------------------------------------------------------------
+    def serving_engine(self, rng: Optional[jax.Array] = None):
+        """The continuous-batching front end over this engine's weights:
+        paged KV pool, iteration-level scheduler, single-trace batched
+        decode.  Gated on the ``serving`` config block."""
+        if not self.config.serving.enabled:
+            raise ValueError(
+                "continuous-batching serving is disabled — set "
+                '{"serving": {"enabled": true}} in the inference config')
+        if self._serving is None:
+            from .serving import ServingEngine
+            self._serving = ServingEngine(self, rng=rng)
+        return self._serving

@@ -1,0 +1,21 @@
+"""Continuous-batching serving subsystem (docs/serving.md).
+
+Three layers, composed by ``InferenceEngine.serving_engine()``:
+
+  * :mod:`block_allocator` — paged KV-cache block pool bookkeeping
+    (PagedAttention-style block tables, refcounted fork, leak checks);
+  * :mod:`scheduler` — Orca-style iteration-level scheduling: FCFS
+    admission, LIFO recompute preemption, completion draining;
+  * :mod:`engine` — the compiled prefill / single-trace decode programs
+    over ``ops/transformer/paged_decode_attention.py``, instrumented
+    with the ``dstpu_serving_*`` observability metrics.
+"""
+from .block_allocator import (BlockPoolError, NULL_BLOCK,  # noqa: F401
+                              PagedBlockAllocator)
+from .engine import ServingEngine  # noqa: F401
+from .scheduler import (ContinuousBatchingScheduler, Request,  # noqa: F401
+                        RequestState)
+
+__all__ = ["BlockPoolError", "NULL_BLOCK", "PagedBlockAllocator",
+           "ContinuousBatchingScheduler", "Request", "RequestState",
+           "ServingEngine"]

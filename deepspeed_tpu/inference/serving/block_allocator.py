@@ -1,0 +1,182 @@
+"""Paged KV-cache block allocator (host side).
+
+The bookkeeping half of PagedAttention (Kwon et al., SOSP '23): device
+HBM holds one preallocated pool of fixed-size KV blocks
+(``models/transformer.py init_paged_cache``); this allocator hands
+block ids to sequences and keeps the pool leak-free.  Everything here is
+pure Python over integers — no jax, so the policy is unit-testable at
+property-test speed and the scheduler can ask "does this admission fit"
+without touching the device.
+
+Invariants (``assert_consistent`` checks them, tests fuzz them):
+
+  * block 0 is RESERVED (the null block): padded block-table entries and
+    inactive decode slots point at it so the kernel's index_map always
+    lands on valid memory; it is never handed out and never freed.
+  * every other block is, at all times, either on the free list exactly
+    once or referenced by >= 1 sequences (refcount > 1 only through
+    :meth:`fork`'s prefix sharing).
+  * ``free``/``allocate`` raise :class:`BlockPoolError` on double-free,
+    unknown sequence ids, and exhaustion — a serving scheduler bug
+    surfaces as a loud error, not a silently corrupted cache.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+NULL_BLOCK = 0
+
+
+class BlockPoolError(RuntimeError):
+    """Allocator invariant violation (double free, exhaustion, unknown
+    sequence) — scheduler bugs, never user input."""
+
+
+class PagedBlockAllocator:
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is the reserved null "
+                f"block), got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # LIFO free list: recently-freed blocks are re-handed first (their
+        # pool pages are the likeliest still warm in any cache hierarchy)
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._ref = [0] * num_blocks
+        self._tables: Dict[str, List[int]] = {}
+
+    # -- capacity ----------------------------------------------------------
+    @property
+    def usable_blocks(self) -> int:
+        """Pool capacity available to sequences (excludes the null block)."""
+        return self.num_blocks - 1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return self.usable_blocks - len(self._free)
+
+    def blocks_for_tokens(self, tokens: int) -> int:
+        """Blocks needed to hold ``tokens`` cache rows (>= 1)."""
+        return max(1, -(-tokens // self.block_size))
+
+    def can_allocate(self, n_blocks: int) -> bool:
+        return len(self._free) >= n_blocks
+
+    # -- alloc / grow / free ----------------------------------------------
+    def allocate(self, seq_id: str, tokens: int) -> List[int]:
+        """Claim blocks for ``tokens`` cache rows; returns the new block
+        table (a copy)."""
+        if seq_id in self._tables:
+            raise BlockPoolError(f"sequence {seq_id!r} already has blocks")
+        need = self.blocks_for_tokens(tokens)
+        if not self.can_allocate(need):
+            raise BlockPoolError(
+                f"pool exhausted: {seq_id!r} needs {need} blocks, "
+                f"{len(self._free)} free of {self.usable_blocks}")
+        blocks = [self._free.pop() for _ in range(need)]
+        for b in blocks:
+            self._ref[b] = 1
+        self._tables[seq_id] = blocks
+        return list(blocks)
+
+    def append_block(self, seq_id: str) -> int:
+        """Grow a sequence by one block (decode crossed a block
+        boundary); raises on exhaustion — the scheduler preempts and
+        retries."""
+        table = self._tables.get(seq_id)
+        if table is None:
+            raise BlockPoolError(f"unknown sequence {seq_id!r}")
+        if not self._free:
+            raise BlockPoolError(
+                f"pool exhausted growing {seq_id!r} "
+                f"({len(table)} blocks held)")
+        b = self._free.pop()
+        self._ref[b] = 1
+        table.append(b)
+        return b
+
+    def block_table(self, seq_id: str) -> List[int]:
+        table = self._tables.get(seq_id)
+        if table is None:
+            raise BlockPoolError(f"unknown sequence {seq_id!r}")
+        return list(table)
+
+    def free(self, seq_id: str) -> None:
+        """Release a sequence's blocks (finish or preemption). Shared
+        blocks (fork) only return to the free list when the last
+        reference drops."""
+        table = self._tables.pop(seq_id, None)
+        if table is None:
+            raise BlockPoolError(
+                f"free of unknown (or already-freed) sequence {seq_id!r}")
+        for b in table:
+            if self._ref[b] <= 0:
+                raise BlockPoolError(
+                    f"double free of block {b} (sequence {seq_id!r})")
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                self._free.append(b)
+
+    def fork(self, src_id: str, dst_id: str,
+             src_tokens: int) -> Optional[int]:
+        """Copy-on-write fork (beam/parallel sampling): ``dst`` shares
+        ``src``'s FULL blocks by reference and gets a private copy of
+        the partially-filled tail block (both branches keep appending
+        there).  Returns the fresh tail block id the caller must copy
+        device-side (``None`` when src's tail landed exactly on a block
+        boundary, i.e. nothing to copy)."""
+        src = self._tables.get(src_id)
+        if src is None:
+            raise BlockPoolError(f"unknown fork source {src_id!r}")
+        if dst_id in self._tables:
+            raise BlockPoolError(f"fork target {dst_id!r} already exists")
+        tail_rows = src_tokens % self.block_size
+        shared = src if tail_rows == 0 else src[:-1]
+        fresh: Optional[int] = None
+        if tail_rows:
+            if not self._free:
+                raise BlockPoolError(
+                    f"pool exhausted forking {src_id!r} -> {dst_id!r}")
+            fresh = self._free.pop()
+            self._ref[fresh] = 1
+        for b in shared:
+            self._ref[b] += 1
+        self._tables[dst_id] = list(shared) + ([fresh] if fresh is not None
+                                               else [])
+        return fresh
+
+    # -- leak check --------------------------------------------------------
+    def assert_consistent(self) -> None:
+        """Every usable block is free exactly once XOR referenced; the
+        null block is neither.  Raises BlockPoolError with the exact
+        discrepancy — the tests' (and a draining server's) leak check."""
+        free_set = set(self._free)
+        if len(free_set) != len(self._free):
+            raise BlockPoolError("free list contains duplicates")
+        if NULL_BLOCK in free_set:
+            raise BlockPoolError("null block 0 leaked onto the free list")
+        held: Dict[int, int] = {}
+        for seq, table in self._tables.items():
+            for b in table:
+                if b == NULL_BLOCK:
+                    raise BlockPoolError(
+                        f"null block 0 inside {seq!r}'s table")
+                held[b] = held.get(b, 0) + 1
+        for b in range(1, self.num_blocks):
+            refs = self._ref[b]
+            in_free = b in free_set
+            if in_free and (refs or b in held):
+                raise BlockPoolError(f"block {b} both free and referenced")
+            if not in_free and refs != held.get(b, 0):
+                raise BlockPoolError(
+                    f"block {b} refcount {refs} != {held.get(b, 0)} "
+                    f"table references")
+            if not in_free and refs == 0:
+                raise BlockPoolError(f"block {b} leaked (no refs, not free)")

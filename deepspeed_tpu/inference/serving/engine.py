@@ -1,0 +1,297 @@
+"""Continuous-batching serving engine: device half of the subsystem.
+
+Couples the host-side policy (``scheduler.py`` + ``block_allocator.py``)
+to three compiled programs:
+
+  * **prefill** (one per padded prompt length): dense-cache forward of a
+    request's prefix, scatter of the resulting KV rows into the paged
+    pool at the slot's block table, first-token sample.  Runs once per
+    (re-)admission, off the steady-state path.
+  * **decode step** (compiled exactly ONCE — the acceptance test pins
+    the build counter): one token for every slot in one program.  Slot
+    liveness travels in the per-slot length vector, so requests join
+    and leave between iterations without changing any program shape.
+  * pools are donated back into each program, so on TPU the decode loop
+    re-dispatches one compiled program over the same HBM buffers — the
+    iteration-level-scheduling analogue of the CUDA-graph replay the
+    reference gets from `inference/engine.py:493`.
+
+Observability (PR-3 layer): queue-depth / batch-occupancy / blocks-in-
+use gauges, TTFT + inter-token-latency histograms, token + preemption
+counters — all under ``dstpu_serving_*`` (docs/serving.md lists them).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...observability import get_registry, trace_span
+from ...utils.logging import logger
+from .block_allocator import PagedBlockAllocator
+from .scheduler import ContinuousBatchingScheduler, Request
+
+
+class ServingEngine:
+    """Continuous-batching front end over an ``InferenceEngine``.
+
+    Usage::
+
+        eng = deepspeed_tpu.init_inference(model, config={
+            "serving": {"enabled": True, "kv_block_size": 16,
+                        "num_kv_blocks": 512, "max_batch_slots": 8}})
+        srv = eng.serving_engine()
+        reqs = [srv.submit(p, max_new_tokens=64) for p in prompts]
+        srv.run()                      # drain
+        streams = [r.output for r in reqs]
+
+    Sampling uses the inference config's ``temperature``/``top_k``/
+    ``top_p`` (temperature 0 = greedy).  Greedy streams are identical
+    to per-request ``generate()`` — the integration test pins it;
+    stochastic sampling draws from the serving engine's own rng stream,
+    so it matches ``generate`` in distribution, not token-for-token.
+    """
+
+    def __init__(self, engine, rng: Optional[jax.Array] = None):
+        cfg = engine.config.serving
+        model = engine.module
+        reason = model._paged_supported()
+        if reason is not None:
+            raise NotImplementedError(
+                f"continuous-batching serving cannot run this model: "
+                f"{reason}")
+        self.engine = engine
+        self.model = model
+        self.block_size = cfg.kv_block_size
+        self.num_slots = cfg.max_batch_slots
+        self.max_pages = max(
+            1, -(-engine.config.max_out_tokens // self.block_size))
+        self.allocator = PagedBlockAllocator(cfg.num_kv_blocks,
+                                             self.block_size)
+        self.scheduler = ContinuousBatchingScheduler(
+            self.num_slots, self.allocator, self.max_pages)
+        pools = model.init_paged_cache(cfg.num_kv_blocks, self.block_size,
+                                       dtype=engine.dtype)
+        self._pool_k, self._pool_v = pools["k"], pools["v"]
+        kv_bytes = self._pool_k.nbytes + self._pool_v.nbytes
+        logger.info(
+            f"serving: paged KV pool {cfg.num_kv_blocks} x "
+            f"{self.block_size}-token blocks "
+            f"({kv_bytes / 2**20:.1f} MiB), {self.num_slots} decode "
+            f"slots, {self.max_pages} pages/seq")
+
+        self.temperature = engine.config.temperature
+        self.top_k = engine.config.top_k
+        self.top_p = engine.config.top_p
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        #: incremented at TRACE time inside the decode program — the
+        #: "compiled decode step traces exactly once" acceptance pin
+        self.decode_builds = 0
+        self._decode_fn = None
+        self._prefill_fns: Dict[int, Any] = {}
+        # donation keeps the pools in-place on TPU; the CPU backend
+        # does not implement donation and would warn every dispatch
+        self._donate = jax.default_backend() == "tpu"
+
+        reg = get_registry()
+        self._m_queue = reg.gauge(
+            "dstpu_serving_queue_depth", "requests waiting for a decode slot")
+        self._m_active = reg.gauge(
+            "dstpu_serving_active_slots",
+            "decode-slot occupancy (continuous batch size)")
+        self._m_blocks = reg.gauge(
+            "dstpu_serving_kv_blocks_in_use", "paged KV pool blocks held")
+        self._m_ttft = reg.histogram(
+            "dstpu_serving_ttft_seconds",
+            "submit -> first token (includes queueing + prefill)")
+        self._m_itl = reg.histogram(
+            "dstpu_serving_inter_token_seconds",
+            "decode-iteration wall time (per-token latency of every "
+            "active stream)")
+        self._m_tokens = reg.counter(
+            "dstpu_serving_tokens_total", "tokens generated by serving")
+        self._m_preempt = reg.counter(
+            "dstpu_serving_preemptions_total",
+            "sequences evicted on KV-pool pressure (recompute on "
+            "re-admission)")
+
+    # ------------------------------------------------------------------
+    # request intake
+    # ------------------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None) -> Request:
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        total = len(prompt) + max_new_tokens
+        if total > self.engine.config.max_out_tokens:
+            raise ValueError(
+                f"prompt+new = {total} exceeds max_out_tokens "
+                f"({self.engine.config.max_out_tokens})")
+        req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
+                      eos_token_id=eos_token_id)
+        self.scheduler.submit(req)
+        self._m_queue.set(self.scheduler.queue_depth)
+        return req
+
+    # ------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------
+    def _build_prefill(self, padded_len: int):
+        engine, model = self.engine, self.model
+        npages = padded_len // self.block_size
+        bs = self.block_size
+
+        def prefill(params, scales, pool_k, pool_v, ids, true_len, pages,
+                    rng):
+            mp = engine._model_params(params, scales)
+            cache = model.init_cache(1, padded_len, dtype=engine.dtype)
+            logits, cache = model.apply(mp, ids, cache=cache)
+            # cache rows [L, 1, padded, kvh, hd] -> [L, npages, bs, ...]
+            def scatter(pool, rows):
+                rows = rows[:, 0].reshape(rows.shape[0], npages, bs,
+                                          *rows.shape[3:])
+                return pool.at[:, pages].set(rows.astype(pool.dtype))
+            pool_k = scatter(pool_k, cache["k"])
+            pool_v = scatter(pool_v, cache["v"])
+            last = jax.lax.dynamic_slice_in_dim(
+                logits, true_len - 1, 1, axis=1)[:, 0]
+            rng, sub = jax.random.split(rng)
+            tok = engine._sample(last, sub, self.temperature, self.top_k,
+                                 self.top_p)
+            return tok[0].astype(jnp.int32), pool_k, pool_v, rng
+
+        get_registry().counter("dstpu_jit_programs_built_total").inc()
+        with self.engine.mesh:
+            return jax.jit(
+                prefill,
+                donate_argnums=(2, 3) if self._donate else ())
+
+    def _build_decode(self):
+        engine, model = self.engine, self.model
+
+        def step(params, scales, pool_k, pool_v, tables, lens, tokens,
+                 rng):
+            # trace-time side effect: counts program BUILDS, not calls —
+            # continuous batching must never retrace this
+            self.decode_builds += 1
+            mp = engine._model_params(params, scales)
+            cache = {"k": pool_k, "v": pool_v, "block_tables": tables,
+                     "lens": lens}
+            logits, cache = model.apply(mp, tokens[:, None], cache=cache)
+            rng, sub = jax.random.split(rng)
+            nxt = engine._sample(logits[:, -1], sub, self.temperature,
+                                 self.top_k, self.top_p)
+            return nxt.astype(jnp.int32), cache["k"], cache["v"], rng
+
+        get_registry().counter("dstpu_jit_programs_built_total").inc()
+        with self.engine.mesh:
+            return jax.jit(
+                step, donate_argnums=(2, 3) if self._donate else ())
+
+    # ------------------------------------------------------------------
+    # one scheduler iteration
+    # ------------------------------------------------------------------
+    def _prefill_request(self, slot: int, req: Request) -> None:
+        prefix = req.prefix
+        p_len = len(prefix)
+        padded = -(-p_len // self.block_size) * self.block_size
+        npages = padded // self.block_size
+        fn = self._prefill_fns.get(padded)
+        if fn is None:
+            fn = self._prefill_fns[padded] = self._build_prefill(padded)
+        ids = np.zeros((1, padded), np.int32)
+        ids[0, :p_len] = prefix
+        pages = np.asarray(
+            self.allocator.block_table(req.req_id)[:npages], np.int32)
+        with trace_span("serving/prefill", slot=slot, tokens=p_len):
+            tok, self._pool_k, self._pool_v, self._rng = fn(
+                self.engine.params, getattr(self.engine, "_scales", None),
+                self._pool_k, self._pool_v, ids,
+                jnp.asarray(p_len, jnp.int32), pages, self._rng)
+            tok = int(tok)
+        req.cached_tokens = p_len
+        req.output.append(tok)
+        if req.first_token_time is None:
+            req.first_token_time = time.perf_counter()
+            self._m_ttft.observe(req.first_token_time - req.submit_time)
+        self._m_tokens.inc()
+        if req.done:
+            self.scheduler.finish(slot)
+
+    def step(self) -> bool:
+        """One continuous-batching iteration: admit, guarantee KV
+        capacity, decode one token for every active slot, retire
+        finished streams.  Returns True while work remains."""
+        sched = self.scheduler
+        # capacity BEFORE admission: running sequences claim their next
+        # block first, so a fresh admission is never immediately chosen
+        # as the LIFO preemption victim (which would discard the prefill
+        # it just paid for)
+        for req in sched.ensure_decode_capacity():
+            self._m_preempt.inc()
+            logger.info(f"serving: preempted {req.req_id} on KV pressure "
+                        f"({req.preemptions} time(s))")
+        for slot, req in sched.schedule_admissions():
+            self._prefill_request(slot, req)
+        self._update_gauges()
+
+        active = [(slot, sched.running[slot])
+                  for slot in sorted(sched.running)]
+        if active:
+            tables = np.zeros((self.num_slots, self.max_pages), np.int32)
+            lens = np.zeros((self.num_slots,), np.int32)
+            tokens = np.zeros((self.num_slots,), np.int32)
+            for slot, req in active:
+                table = self.allocator.block_table(req.req_id)
+                tables[slot, :len(table)] = table
+                lens[slot] = req.cached_tokens
+                tokens[slot] = req.output[-1]
+            if self._decode_fn is None:
+                self._decode_fn = self._build_decode()
+            t0 = time.perf_counter()
+            with trace_span("serving/decode", batch=len(active)):
+                nxt, self._pool_k, self._pool_v, self._rng = \
+                    self._decode_fn(
+                        self.engine.params,
+                        getattr(self.engine, "_scales", None),
+                        self._pool_k, self._pool_v, tables, lens, tokens,
+                        self._rng)
+                nxt = np.asarray(nxt)
+            self._m_itl.observe(time.perf_counter() - t0)
+            self._m_tokens.inc(len(active))
+            for slot, req in active:
+                req.cached_tokens += 1
+                req.output.append(int(nxt[slot]))
+                if req.done:
+                    sched.finish(slot)
+        self._update_gauges()
+        return sched.has_work
+
+    def _update_gauges(self) -> None:
+        self._m_queue.set(self.scheduler.queue_depth)
+        self._m_active.set(self.scheduler.active_slots)
+        self._m_blocks.set(self.allocator.num_used)
+
+    def run(self, max_steps: Optional[int] = None) -> List[Request]:
+        """Drain the queue; returns the finished requests.  A bounded
+        ``max_steps`` turns a scheduler bug into a loud error instead of
+        a spin."""
+        steps = 0
+        while self.step():
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"serving did not drain within {max_steps} steps "
+                    f"({self.scheduler.queue_depth} queued, "
+                    f"{self.scheduler.active_slots} running)")
+        # a drained pool must hold zero sequence blocks — leak check
+        self.allocator.assert_consistent()
+        if self.allocator.num_used:
+            from .block_allocator import BlockPoolError
+            raise BlockPoolError(
+                f"{self.allocator.num_used} KV blocks still held after "
+                f"drain — scheduler leak")
+        return list(self.scheduler.finished)

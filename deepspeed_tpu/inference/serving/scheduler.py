@@ -1,0 +1,206 @@
+"""Continuous-batching scheduler (iteration-level, Orca-style).
+
+Host-side policy for the serving engine: which request enters a decode
+slot, who gets preempted when the KV pool runs dry, when a request is
+done.  Orca (Yu et al., OSDI '22) made the case that the scheduling
+quantum for LLM serving must be ONE decode iteration — requests join
+and leave the running batch between iterations instead of waiting for
+the whole batch to finish.  Here that batch is a fixed set of
+``num_slots`` decode slots (so the compiled decode step never
+retraces); a slot's liveness is carried by its per-slot length
+(0 = inactive), not by the program shape.
+
+State machine per request::
+
+    WAITING --admit--> RUNNING --finish(eos | max_new)--> FINISHED
+       ^                  |
+       +---- preempt -----+   (KV pressure; re-enters at queue FRONT,
+                               recompute-style: prompt + generated so
+                               far prefill again on re-admission)
+
+Policies (deliberately simple and deterministic, pinned by tests):
+
+  * admission: FCFS with head-of-line blocking — the head request
+    admits iff a slot is free AND the pool covers its prefix + 1
+    token.  No skip-ahead, so admission order == submission order and
+    token streams are reproducible.
+  * preemption: when a running sequence crosses a block boundary and
+    the pool is dry, the LATEST-admitted running sequence is evicted
+    (LIFO victim choice — the one that wasted the least work), its
+    blocks are freed, and it re-queues at the front.  Recompute beats
+    swap here: re-prefill is one dense pass, and the paged pool has no
+    host-side swap tier yet.
+
+Pure Python + the allocator — no jax; the engine owns device state.
+"""
+from __future__ import annotations
+
+import enum
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+from .block_allocator import BlockPoolError, PagedBlockAllocator
+
+
+class RequestState(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+_req_counter = itertools.count()
+
+
+@dataclass
+class Request:
+    """One generation request and its full lifecycle record."""
+    prompt: List[int]
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    req_id: str = field(
+        default_factory=lambda: f"req-{next(_req_counter)}")
+    state: RequestState = RequestState.WAITING
+    output: List[int] = field(default_factory=list)
+    #: tokens whose KV currently sits in the pool (prompt + generated
+    #: minus the newest sampled token, which writes on the next decode)
+    cached_tokens: int = 0
+    preemptions: int = 0
+    submit_time: float = field(default_factory=time.perf_counter)
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+
+    @property
+    def prefix(self) -> List[int]:
+        """What prefill must process on (re-)admission: the prompt plus
+        everything already generated (recompute-style preemption)."""
+        return list(self.prompt) + list(self.output)
+
+    @property
+    def done(self) -> bool:
+        if len(self.output) >= self.max_new_tokens:
+            return True
+        return (self.eos_token_id is not None and bool(self.output)
+                and self.output[-1] == self.eos_token_id)
+
+
+class ContinuousBatchingScheduler:
+    def __init__(self, num_slots: int, allocator: PagedBlockAllocator,
+                 max_blocks_per_seq: int):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.num_slots = num_slots
+        self.alloc = allocator
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.waiting: Deque[Request] = deque()
+        self.running: Dict[int, Request] = {}      # slot -> request
+        self._admit_order: List[int] = []          # slots, oldest first
+        self.finished: List[Request] = []
+        self.preemption_count = 0
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def active_slots(self) -> int:
+        return len(self.running)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def max_tokens_per_seq(self) -> int:
+        return self.max_blocks_per_seq * self.alloc.block_size
+
+    # -- lifecycle ---------------------------------------------------------
+    def submit(self, req: Request) -> Request:
+        """Queue a request. Validates it can EVER fit (prompt + new
+        tokens within one slot's table and the pool) so admission never
+        deadlocks on an impossible head-of-line request."""
+        total = len(req.prompt) + req.max_new_tokens
+        need = self.alloc.blocks_for_tokens(total)
+        if not req.prompt:
+            raise ValueError("empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if need > self.max_blocks_per_seq or \
+                need > self.alloc.usable_blocks:
+            raise ValueError(
+                f"request needs {need} KV blocks "
+                f"({len(req.prompt)} prompt + {req.max_new_tokens} new "
+                f"tokens, block {self.alloc.block_size}) but a sequence "
+                f"may hold at most "
+                f"{min(self.max_blocks_per_seq, self.alloc.usable_blocks)}"
+                f" — raise serving.num_kv_blocks / max_out_tokens")
+        self.waiting.append(req)
+        return req
+
+    def schedule_admissions(self) -> List[Tuple[int, Request]]:
+        """FCFS admission into free slots while the pool covers each
+        head request's prefix + 1 decode token.  Returns
+        ``[(slot, request), ...]`` for the engine to prefill."""
+        admitted: List[Tuple[int, Request]] = []
+        while self.waiting and len(self.running) < self.num_slots:
+            req = self.waiting[0]
+            need = self.alloc.blocks_for_tokens(len(req.prefix) + 1)
+            if not self.alloc.can_allocate(need):
+                break                      # head-of-line blocks: FCFS order
+            self.waiting.popleft()
+            slot = min(set(range(self.num_slots)) - set(self.running))
+            self.alloc.allocate(req.req_id, len(req.prefix) + 1)
+            req.state = RequestState.RUNNING
+            req.cached_tokens = 0          # prefill pending
+            self.running[slot] = req
+            self._admit_order.append(slot)
+            admitted.append((slot, req))
+        return admitted
+
+    def ensure_decode_capacity(self) -> List[Request]:
+        """Before a decode iteration: every running sequence must own a
+        block for its next write position.  Grows tables; on pool
+        exhaustion preempts latest-admitted sequences (possibly the one
+        asking) until the rest fit.  Returns the preempted requests."""
+        preempted: List[Request] = []
+        for slot in list(self._admit_order):           # oldest first
+            req = self.running.get(slot)
+            if req is None:
+                continue
+            while True:
+                need = self.alloc.blocks_for_tokens(req.cached_tokens + 1)
+                have = len(self.alloc.block_table(req.req_id))
+                if have >= need:
+                    break
+                try:
+                    self.alloc.append_block(req.req_id)
+                except BlockPoolError:
+                    victim_slot = self._admit_order[-1]
+                    victim = self.running[victim_slot]
+                    self._preempt(victim_slot, victim)
+                    preempted.append(victim)
+                    if victim is req:
+                        break              # evicted itself; next slot
+        return preempted
+
+    def _preempt(self, slot: int, req: Request) -> None:
+        self.alloc.free(req.req_id)
+        del self.running[slot]
+        self._admit_order.remove(slot)
+        req.state = RequestState.WAITING
+        req.cached_tokens = 0
+        req.preemptions += 1
+        self.preemption_count += 1
+        # front of the queue, so the original admission order is preserved
+        self.waiting.appendleft(req)
+
+    def finish(self, slot: int) -> Request:
+        req = self.running.pop(slot)
+        self._admit_order.remove(slot)
+        self.alloc.free(req.req_id)
+        req.state = RequestState.FINISHED
+        req.finish_time = time.perf_counter()
+        self.finished.append(req)
+        return req

@@ -25,13 +25,31 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from . import layers as L
+
+
+class PagedKVCache(NamedTuple):
+    """One transformer layer's slice of the paged serving KV state —
+    the marker type ``_attention`` dispatches on for the
+    continuous-batching decode path (``inference/serving/``).
+
+      k_pool / v_pool  [num_blocks, block, kv_heads, head_dim]
+      block_tables     [B, pages] int32 (pool block ids; tail entries
+                       hold the reserved null block 0)
+      lens             [B] int32 — tokens ALREADY in the cache per slot
+                       (the new token writes at position ``lens``;
+                       0 = inactive slot)
+    """
+    k_pool: Any
+    v_pool: Any
+    block_tables: Any
+    lens: Any
 
 
 @dataclasses.dataclass(frozen=True)
@@ -522,6 +540,10 @@ class TransformerLM:
 
         new_cache = None
         offset = 0
+        if isinstance(cache_kv, PagedKVCache):
+            # continuous-batching decode: per-slot write into the shared
+            # block pool + batched paged-attention kernel
+            return self._paged_attention(p, q, k, v, cache_kv, b, t, nh, hd)
         if cache_kv is None and c.attn_impl in ("ring", "ulysses",
                                                 "blocksparse", "flash"):
             k, v = expand_kv(k), expand_kv(v)
@@ -658,6 +680,43 @@ class TransformerLM:
         o = o.reshape(b, t, nh * hd)
         return L.dense_apply(p["out"], o), new_cache
 
+    def _paged_attention(self, p, q, k, v, paged: PagedKVCache, b, t, nh,
+                         hd):
+        """Ragged-batch decode against a paged KV pool (one layer).
+
+        q/k/v [B, 1, nh|kvh, hd] — the new token per slot, rotary
+        already applied with per-slot positions.  The new k/v scatter
+        into each slot's current block (slots own disjoint blocks, so
+        the write indices never collide; inactive slots write into the
+        reserved null block 0), then the batched Pallas kernel attends
+        over the block tables with per-slot lengths — no per-step cache
+        copy, no ``jnp.pad``."""
+        if t != 1:
+            raise NotImplementedError(
+                f"paged decode is token-at-a-time (t=1), got t={t} — "
+                f"prompts prefill through the dense cache path")
+        pool_k, pool_v, tables, lens = paged
+        nb, blk = pool_k.shape[0], pool_k.shape[1]
+        slot = jnp.arange(b)
+        # write position of the new token: block_table[len // blk]
+        # offset len % blk, flattened over [nb * blk] rows
+        write = tables[slot, lens // blk] * blk + lens % blk
+        flat = (nb * blk,) + pool_k.shape[2:]
+        pool_k = pool_k.reshape(flat).at[write].set(
+            k[:, 0].astype(pool_k.dtype)).reshape(pool_k.shape)
+        pool_v = pool_v.reshape(flat).at[write].set(
+            v[:, 0].astype(pool_v.dtype)).reshape(pool_v.shape)
+        from ..ops.transformer.paged_decode_attention import (
+            paged_decode_attention)
+        o = paged_decode_attention(
+            q[:, 0], pool_k.astype(q.dtype), pool_v.astype(q.dtype),
+            # inactive slots (lens 0) must stay 0 so the kernel's
+            # null-block page is masked off, not attended
+            jnp.where(lens > 0, lens + 1, 0), tables,
+            sm_scale=self._attn_scale)
+        o = o.reshape(b, t, nh * hd)
+        return L.dense_apply(p["out"], o), (pool_k, pool_v)
+
     def _mlp(self, p, x):
         xq = self._maybe_qact(x, "mlp_in")
         if self.config.gated_mlp:
@@ -781,6 +840,9 @@ class TransformerLM:
                 params, input_ids, train=False,
                 token_type_ids=token_type_ids)
             return self._project(params, x)
+
+        if "block_tables" in cache:
+            return self._apply_paged_decode(params, input_ids, cache)
 
         idx = cache["index"]
         if positions is None:
@@ -911,6 +973,75 @@ class TransformerLM:
     def hidden_states(self, params, input_ids):
         """Forward up to the final norm, pre-projection ([B,T,D])."""
         return self.hidden_states_and_aux(params, input_ids)[0]
+
+    def _paged_supported(self) -> Optional[str]:
+        """None when the paged decode path serves this config, else the
+        reason it cannot (the serving engine surfaces it at build)."""
+        c = self.config
+        if not c.causal:
+            return "paged decode needs a causal (decoder) model"
+        if c.moe_enabled:
+            return "paged decode does not cover MoE block stacks yet"
+        if c.attention_layers:
+            return ("paged decode does not apply per-layer local windows "
+                    "(GPT-Neo family)")
+        if c.pos_embedding == "alibi":
+            return "paged decode does not carry the ALiBi bias yet"
+        from ..ops.transformer.paged_decode_attention import supports
+        if not supports(c.hdim):
+            return f"head_dim {c.hdim} is not lane-aligned (multiple of 8)"
+        return None
+
+    def _apply_paged_decode(self, params, input_ids, cache):
+        """Continuous-batching decode step: one new token per slot
+        against the paged KV pool.
+
+        ``cache``: {"k"/"v": [L, num_blocks, block, kv_heads, hd] pools,
+        "block_tables": [B, pages] int32, "lens": [B] int32 (tokens
+        already cached per slot; 0 = inactive)}.  Returns
+        ``(logits [B, 1, V], cache with updated pools and lens + 1)``.
+        Slots advance independently — this is the program the serving
+        scheduler re-dispatches every iteration without retracing."""
+        reason = self._paged_supported()
+        if reason is not None:
+            raise NotImplementedError(reason)
+        if input_ids.shape[1] != 1:
+            raise NotImplementedError(
+                "paged decode consumes one token per slot per step")
+        tables, lens = cache["block_tables"], cache["lens"]
+        positions = lens[:, None]          # each slot decodes at its own pos
+        x = self._embed_tokens(params, input_ids, positions=positions)
+
+        def scan_fn(carry, xs):
+            bp, pk, pv = xs
+            bp = self.block_transform(bp)
+            y, (npk, npv) = self._block(
+                bp, carry, PagedKVCache(pk, pv, tables, lens), positions)
+            return y, (npk, npv)
+
+        x, (nk, nv) = jax.lax.scan(scan_fn, x,
+                                   (params["blocks"], cache["k"],
+                                    cache["v"]))
+        if self.config.final_layernorm:
+            x = self._norm_fn()(params["ln_f"], x)
+        new_cache = {"k": nk, "v": nv, "block_tables": tables,
+                     "lens": jnp.where(lens > 0, lens + 1, 0)}
+        return self._project(params, x), new_cache
+
+    def init_paged_cache(self, num_blocks: int, block_size: int,
+                         dtype=None) -> Dict:
+        """Preallocated paged KV pool for continuous-batching serving:
+        ``num_blocks`` fixed-size blocks of ``block_size`` tokens shared
+        by every sequence through per-slot block tables (block 0 is the
+        allocator's reserved null block).  Pools are per layer; tables
+        and lens start empty — the serving engine owns them."""
+        reason = self._paged_supported()
+        if reason is not None:
+            raise NotImplementedError(reason)
+        c = self.config
+        dtype = dtype or c.dtype
+        shape = (c.num_layers, num_blocks, block_size, c.kv_heads, c.hdim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
     def init_cache(self, batch: int, max_len: int, dtype=None) -> Dict:
         c = self.config

@@ -1,0 +1,25 @@
+"""Version portability for the Pallas TPU surface.
+
+jax renamed ``pltpu.TPUCompilerParams`` to ``pltpu.CompilerParams``;
+depending on the pinned jax, exactly one of the two exists (0.4.x ships
+only the TPU-prefixed name, current jax only the bare one, a window in
+between both).  Kernels import :func:`compiler_params` instead of
+touching either class so the same source runs on every jax this repo
+meets (laptop CPU CI on 0.4.x, the tunnel's newer TPU build).
+
+New kernels should route through here; the pre-existing kernels still
+spell ``pltpu.CompilerParams`` directly and can migrate when their
+suites are next touched.
+"""
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+_CLS = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams")
+
+
+def compiler_params(**kwargs):
+    """``pltpu.CompilerParams(**kwargs)`` under whichever name this jax
+    exports."""
+    return _CLS(**kwargs)

@@ -26,15 +26,53 @@ from .sparsity_config import SparsityConfig
 
 MASK_VALUE = -1e30
 
+#: cost-based routing defaults, motivated by BENCH_ALL_r04 on the v5e:
+#: the sliding-window blocksparse path ran 101.31 ms at seq 8k (layout
+#: density 0.121) where dense flash took 17.02 ms, but won 2.58x at seq
+#: 16k (density 0.062: 103.07 vs 266.19 ms) — block sparsity only wins
+#: once it prunes MOST of the work.  Routing terms:
+#:
+#:   * full/causal-equivalent layouts ALWAYS route dense: the gather
+#:     path would materialize the same T^2 score memory and add per-
+#:     block gather/segment overhead on top — dense (flash when the
+#:     sequence is long enough) strictly dominates;
+#:   * genuinely masked layouts route dense when the layout is not
+#:     sparse enough to win (density >= DENSE_ROUTE_DENSITY — the 8k
+#:     case sits at 0.121, the 16k win at 0.062) or the attended work
+#:     per query row is tiny (density * seq < DENSE_ROUTE_MIN_TOKENS —
+#:     fixed per-block overheads dominate at unit-test scale), but ONLY
+#:     below DENSE_ROUTE_MAX_MASKED_SEQ: the masked dense fallback
+#:     materializes the [B, H, T, T] score tensor (no mask input on the
+#:     flash kernel), so past that bound the sparse path's smaller
+#:     nnz-proportional footprint wins regardless of kernel efficiency.
+DENSE_ROUTE_DENSITY = 0.1
+DENSE_ROUTE_MIN_TOKENS = 512
+DENSE_ROUTE_MAX_MASKED_SEQ = 2048
+
 
 class SparseSelfAttention:
     """Callable attention module bound to a SparsityConfig (reference
-    `sparse_self_attention.py` SparseSelfAttention)."""
+    `sparse_self_attention.py` SparseSelfAttention).
+
+    Routing: ``__call__`` only takes the gathered-block sparse path when
+    the layout is sparse enough to win (`routes_dense`); otherwise it
+    computes the SAME masked attention through the dense path — dense
+    `flash_attention` when the layout covers full/causal attention, a
+    masked dense pass otherwise.  Semantics never change with the route,
+    only the algorithm (pinned by the routing tests)."""
 
     def __init__(self, sparsity_config: SparsityConfig,
-                 max_seq_length: int):
+                 max_seq_length: int,
+                 dense_route_density: float = DENSE_ROUTE_DENSITY,
+                 dense_route_min_tokens: float = DENSE_ROUTE_MIN_TOKENS,
+                 dense_route_max_masked_seq: int =
+                 DENSE_ROUTE_MAX_MASKED_SEQ):
         self.config = sparsity_config
         self.block = sparsity_config.block
+        self.dense_route_density = dense_route_density
+        self.dense_route_min_tokens = dense_route_min_tokens
+        self.dense_route_max_masked_seq = dense_route_max_masked_seq
+        self._dense_mask = None           # lazy [T, T] mask
         self.layout = sparsity_config.make_layout(max_seq_length)
         if getattr(sparsity_config, "attention",
                    "bidirectional") == "unidirectional":
@@ -50,6 +88,76 @@ class SparseSelfAttention:
         self.num_blocks = n
         # causal handling needs in-block masks on diagonal blocks
         self._diag = jnp.asarray(rows == cols)
+        # dense-equivalence kind, from the BLOCK layout alone (never
+        # materializes the [T, T] mask): 'full' = no masking at all,
+        # 'causal' = exactly lower-triangular, 'masked' = anything else
+        uni = getattr(sparsity_config, "attention",
+                      "bidirectional") == "unidirectional"
+        lay = np.asarray(self.layout, bool)
+        if not uni and lay.all():
+            self.mask_kind = "full"
+        elif uni and (lay == np.tril(np.ones_like(lay))).all():
+            self.mask_kind = "causal"
+        else:
+            self.mask_kind = "masked"
+
+    def routes_dense(self, seq_len: int) -> bool:
+        """Cost-based route (see the module-level calibration note):
+        True when the DENSE path is expected to beat the gathered-block
+        sparse path for this layout at ``seq_len``."""
+        if self.mask_kind in ("full", "causal"):
+            # the gather path would do the same T^2 score work PLUS
+            # per-block overhead — dense strictly dominates
+            return True
+        density = self.density()
+        # masked layouts: the dense fallback materializes [B, H, T, T]
+        # scores, so it is only eligible below the memory bound
+        return (seq_len <= self.dense_route_max_masked_seq
+                and (density >= self.dense_route_density
+                     or density * seq_len < self.dense_route_min_tokens))
+
+    def _layout_mask(self, t: int):
+        """Lazily-built [T, T] bool mask equivalent to the block layout
+        (+ in-block causal for unidirectional) — only materialized when
+        the masked dense route actually executes."""
+        if self._dense_mask is None:
+            blk = self.block
+            mask = np.kron(np.asarray(self.layout, bool),
+                           np.ones((blk, blk), bool))
+            if getattr(self.config, "attention",
+                       "bidirectional") == "unidirectional":
+                mask &= np.tril(np.ones_like(mask))
+            self._dense_mask = jnp.asarray(mask)
+        if self._dense_mask.shape[0] != t:
+            raise ValueError(f"seq {t} != layout "
+                             f"{self.num_blocks}x{self.block}")
+        return self._dense_mask
+
+    def _dense_attention(self, q, k, v, sm_scale):
+        """The dense route: same masked softmax-attention, computed
+        without the block gather.  Full/causal-equivalent layouts ride
+        the Pallas dense flash kernel once the sequence is long enough
+        for its grid to pay off; everything else runs a masked dense
+        pass (identical numerics contract to the sparse path: fp32
+        scores, MASK_VALUE fill)."""
+        t = q.shape[1]
+        kind = self.mask_kind
+        default_scale = abs(sm_scale - 1.0 / math.sqrt(q.shape[-1])) < 1e-12
+        if kind in ("full", "causal") and default_scale and t >= 1024:
+            from ..transformer.flash_attention import (flash_attention_bthd,
+                                                       supports)
+            if supports(t, t):
+                return flash_attention_bthd(q, k, v,
+                                            causal=(kind == "causal"))
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * sm_scale
+        if kind == "causal":
+            tri = (jnp.arange(t)[:, None] >= jnp.arange(t)[None, :])
+            s = jnp.where(tri[None, None], s, MASK_VALUE)
+        elif kind == "masked":
+            s = jnp.where(self._layout_mask(t)[None, None], s, MASK_VALUE)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
     def __call__(self, q, k, v, sm_scale: Optional[float] = None):
         """q, k, v: [B, T, H, D] → [B, T, H, D]. Layout True blocks only."""
@@ -59,6 +167,8 @@ class SparseSelfAttention:
             raise ValueError(f"seq {t} != layout {nb}x{blk}")
         if sm_scale is None:
             sm_scale = 1.0 / math.sqrt(d)
+        if self.routes_dense(t):
+            return self._dense_attention(q, k, v, sm_scale)
 
         def pack(x):   # [B,T,H,D] -> [BH, nb, blk, D]
             return (x.transpose(0, 2, 1, 3)

@@ -1,0 +1,212 @@
+"""Batched paged decode attention — Pallas TPU kernel for serving.
+
+The multi-sequence extension of ``decode_attention.py``: that kernel
+serves ONE ragged dimension (a single shared ``length`` scalar) and
+assumes each sequence owns a contiguous ``[S, H, D]`` cache line.  A
+continuous-batching server holds neither — sequences join and leave the
+batch between iterations, their lengths diverge, and their KV lives in
+fixed-size blocks of a shared pool indexed through per-sequence block
+tables (PagedAttention, Kwon et al. SOSP '23; `inference/serving/`
+builds the allocator).
+
+Kernel design:
+
+  * grid ``(slot, page)`` — one decode slot per batch row, one KV block
+    ("page") per inner step; ``dimension_semantics=("parallel",
+    "arbitrary")`` so slots spread across cores while the page walk
+    stays sequential for the online-softmax accumulator.
+  * the per-slot valid length and the ``[slots, pages]`` block table are
+    scalar-prefetch operands: the page BlockSpec index_map reads
+    ``table[slot, page]`` so only the blocks a slot actually owns are
+    ever DMA'd.  Pages past a slot's length re-map to the slot's LAST
+    valid block — Pallas skips the copy when the block index does not
+    change, so a short sequence in a long-batch grid costs no extra HBM
+    traffic (the ``jnp.pad`` full-cache copy the dense batched fallback
+    would take simply has no equivalent here).
+  * inactive slots (length 0) map to pool block 0 — the allocator's
+    reserved null block — and produce all-zero output rows.
+  * GQA: the pool stores ``kv_heads`` heads; query heads fold into
+    ``[kv_heads, group]`` inside the kernel so grouped models pay
+    kv-width HBM traffic (the reason GQA exists) without a repeated-KV
+    materialization.
+
+Layout contract: q ``[B, H, D]`` (one new token per slot), pool k/v
+``[num_blocks, block, Hkv, D]``, lengths ``[B]`` int32 (valid cache
+prefix per slot, INCLUDING the just-written token; 0 = inactive slot),
+block_tables ``[B, pages]`` int32.  Returns ``[B, H, D]``.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..pallas_compat import compiler_params
+
+from .decode_attention import MASK_VALUE, _interpret_default, _rowscale
+
+
+def _kernel(len_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+            m_scr, l_scr, acc_scr, *, sm_scale, block, groups):
+    """Online-softmax walk over one slot's pages, all heads per page.
+
+    q_ref [H, D]; k_ref/v_ref [block, Hkv, D] (the page the index_map
+    selected via the block table); o_ref [H, D]; scratch m/l [1, H],
+    acc [H, D]."""
+    p = pl.program_id(1)
+    npages = pl.num_programs(1)
+    length = len_ref[pl.program_id(0)]
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(p * block < length)
+    def _body():
+        q = q_ref[...].astype(jnp.float32)            # [H, D]
+        k = k_ref[...].astype(jnp.float32)            # [block, Hkv, D]
+        h, d = q.shape
+        if groups == 1:
+            scores = jnp.sum(k * q[None], axis=-1)    # [block, H]
+        else:
+            # grouped query heads: q row j*groups+g reads kv head j, so
+            # [Hkv, groups, D] q against [block, Hkv, 1, D] kv broadcasts
+            # to [block, Hkv, groups] and flattens back to [block, H]
+            qg = q.reshape(h // groups, groups, d)
+            scores = jnp.sum(k[:, :, None, :] * qg[None],
+                             axis=-1).reshape(k.shape[0], h)
+        scores = scores * sm_scale
+        pos = p * block + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 0)
+        scores = jnp.where(pos < length, scores, MASK_VALUE)
+        m_prev = m_scr[...]                           # [1, H]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(scores, axis=0, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)               # [1, H]
+        probs = jnp.exp(scores - m_new)               # [block, H]
+        l_scr[...] = alpha * l_scr[...] + jnp.sum(probs, axis=0,
+                                                  keepdims=True)
+        v = v_ref[...].astype(jnp.float32)            # [block, Hkv, D]
+        if groups == 1:
+            pv = jnp.sum(probs[:, :, None] * v, axis=0)       # [H, D]
+        else:
+            pg = probs.reshape(k.shape[0], h // groups, groups)
+            pv = jnp.sum(pg[..., None] * v[:, :, None, :],
+                         axis=0).reshape(h, d)
+        acc_scr[...] = _rowscale(alpha, acc_scr[...]) + pv
+        m_scr[...] = m_new
+
+    @pl.when(p == npages - 1)
+    def _out():
+        # length-0 (inactive) slots never ran a page: l stays 0 and the
+        # clamp below turns the row into zeros instead of 0/0
+        inv = 1.0 / jnp.maximum(l_scr[...], 1e-30)    # [1, H]
+        o_ref[...] = _rowscale(inv, acc_scr[...]).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jnp.ndarray, pool_k: jnp.ndarray,
+                           pool_v: jnp.ndarray, lengths: jnp.ndarray,
+                           block_tables: jnp.ndarray,
+                           sm_scale: Optional[float] = None,
+                           interpret: Optional[bool] = None) -> jnp.ndarray:
+    """q [B, H, D]; pool_k/v [num_blocks, block, Hkv, D]; lengths [B]
+    int32 (valid tokens per slot, 0 = inactive); block_tables [B, pages]
+    int32 (pool block ids; unused entries must hold a VALID id — the
+    allocator pads with the reserved null block 0).  Returns [B, H, D];
+    inactive slots come back as zero rows.
+
+    The caller guarantees ``lengths[i] <= pages * block`` and that every
+    table entry below ``ceil(lengths[i]/block)`` points at that slot's
+    own blocks.
+    """
+    b, h, d = q.shape
+    nb, block, hkv = pool_k.shape[0], pool_k.shape[1], pool_k.shape[2]
+    if pool_v.shape != pool_k.shape:
+        raise ValueError(f"pool_k {pool_k.shape} != pool_v {pool_v.shape}")
+    if h % hkv:
+        raise ValueError(f"query heads {h} not a multiple of kv heads {hkv}")
+    if block_tables.ndim != 2 or block_tables.shape[0] != b:
+        raise ValueError(
+            f"block_tables must be [B={b}, pages], got {block_tables.shape}")
+    groups = h // hkv
+    npages = block_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = _interpret_default()
+    lengths = jnp.asarray(lengths, jnp.int32).reshape(b)
+    block_tables = jnp.asarray(block_tables, jnp.int32)
+
+    def page_index(i, p, len_ref, bt_ref):
+        # pages past the valid prefix revisit the slot's last valid
+        # block: an unchanged block index skips the DMA, so the ragged
+        # tail of a short slot is free.  length 0 degenerates to the
+        # table's first entry (the null block).
+        last = jnp.maximum(
+            (len_ref[i] + block - 1) // block - 1, 0)
+        return (bt_ref[i, jnp.minimum(p, last)], 0, 0, 0)
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, sm_scale=sm_scale, block=block,
+                          groups=groups),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, npages),
+            in_specs=[
+                pl.BlockSpec((None, h, d), lambda i, p, *_: (i, 0, 0)),
+                pl.BlockSpec((None, block, hkv, d), page_index),
+                pl.BlockSpec((None, block, hkv, d), page_index),
+            ],
+            out_specs=pl.BlockSpec((None, h, d),
+                                   lambda i, p, *_: (i, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((1, h), jnp.float32),
+                pltpu.VMEM((1, h), jnp.float32),
+                pltpu.VMEM((h, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        compiler_params=compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(lengths, block_tables, q, pool_k, pool_v)
+    return out
+
+
+def paged_attention_reference(q, pool_k, pool_v, lengths, block_tables):
+    """Readable jnp reference (tests pin the kernel against this): per
+    slot, gather the table's pages into a contiguous cache and run
+    masked dense attention.  O(B·pages·block) gather — test-scale only."""
+    b, h, d = q.shape
+    block = pool_k.shape[1]
+    hkv = pool_k.shape[2]
+    npages = block_tables.shape[1]
+    g = h // hkv
+
+    def one(qi, table, length):
+        k = pool_k[table].reshape(npages * block, hkv, d)
+        v = pool_v[table].reshape(npages * block, hkv, d)
+        if g > 1:
+            k = jnp.repeat(k, g, axis=1)
+            v = jnp.repeat(v, g, axis=1)
+        s = jnp.einsum("hd,shd->hs", qi.astype(jnp.float32),
+                       k.astype(jnp.float32)) / math.sqrt(d)
+        s = jnp.where(jnp.arange(npages * block)[None] < length, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("hs,shd->hd", p, v.astype(jnp.float32))
+        return jnp.where(length > 0, out, 0.0).astype(qi.dtype)
+
+    return jax.vmap(one)(q, block_tables, lengths)
+
+
+def supports(head_dim: int) -> bool:
+    """Lane-aligned head dim keeps the VPU/MXU fed; lengths and batch
+    are unbounded (KV pages stream through VMEM)."""
+    return head_dim % 8 == 0

@@ -111,6 +111,14 @@ OBSERVABILITY_TRACE_DIR_DEFAULT = "traces"
 OBSERVABILITY_METRICS_ENABLED_DEFAULT = False
 OBSERVABILITY_EXPORT_INTERVAL_DEFAULT = 0       # steps; 0 = flush-only
 
+# Serving (continuous batching) block defaults — the ``serving`` block
+# of the INFERENCE config (inference/config.py ServingConfig,
+# inference/serving/, docs/serving.md). Declared here so the whole JSON
+# schema stays in one file (dstpu-lint CFG rules).
+SERVING_KV_BLOCK_SIZE_DEFAULT = 16      # tokens per paged KV block
+SERVING_NUM_KV_BLOCKS_DEFAULT = 512     # pool blocks (block 0 reserved)
+SERVING_MAX_BATCH_SLOTS_DEFAULT = 8     # compiled decode-batch width
+
 ROUTE_TRAIN = "train"
 ROUTE_EVAL = "eval"
 ROUTE_PREDICT = "predict"

@@ -38,7 +38,8 @@ for f in tests/unit/test_*.py; do
   if [[ -n "$FILTER" && "$f" != *"$FILTER"* ]]; then
     continue
   fi
-  if [[ "$f" == *test_resilience.py || "$f" == *test_observability.py ]]; then
+  if [[ "$f" == *test_resilience.py || "$f" == *test_observability.py \
+        || "$f" == *test_serving.py ]]; then
     continue   # each runs once in its marker sweep below, not twice
   fi
   echo "=== $f"
@@ -73,6 +74,20 @@ if [[ -z "$FILTER" || "observability" == *"$FILTER"* ]]; then
     PASSED=$((PASSED + 1))
   else
     FAILED+=("pytest -m observability")
+  fi
+fi
+
+# Inference/serving sweep: paged decode-attention kernel parity, block
+# allocator leak properties, and the continuous-batching integration
+# test (pytest.ini `inference` marker; docs/serving.md) — all forced-CPU
+# (the kernel runs in interpret mode off-TPU).
+if [[ -z "$FILTER" || "inference" == *"$FILTER"* || "serving" == *"$FILTER"* ]]; then
+  echo "=== inference/serving marker sweep (pytest -m inference)"
+  if JAX_PLATFORMS=cpu python -m pytest tests/unit/test_serving.py \
+       -m inference -q --tb=short ${EXTRA_PYTEST_ARGS:-}; then
+    PASSED=$((PASSED + 1))
+  else
+    FAILED+=("pytest -m inference")
   fi
 fi
 

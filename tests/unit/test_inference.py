@@ -116,6 +116,20 @@ class TestInferenceEngine:
         stats = eng.latency_stats()
         assert "p50_ms" in stats and stats["p50_ms"] > 0
 
+    def test_latency_split_ttft_vs_decode(self):
+        """PR-4 satellite: per-token latency is DECODE-only (the old
+        number divided whole-call wall time, prefill included, by
+        max_new_tokens) and TTFT is reported as its own quantity."""
+        eng = self._engine(replace_with_kernel_inject=False)
+        ids = prompt()
+        for _ in range(3):
+            eng.generate(ids, max_new_tokens=6, temperature=0.0)
+        stats = eng.latency_stats()
+        assert stats["p50_ms"] > 0 and stats["ttft_p50_ms"] > 0
+        assert "ttft_p90_ms" in stats and stats["tokens_per_sec"] > 0
+        # one TTFT and one decode sample per generate call
+        assert len(eng._ttfts) == 3 and len(eng._latencies) == 3
+
     def test_eos_padding(self):
         eng = self._engine()
         out = np.asarray(eng.generate(prompt(), max_new_tokens=8,
