@@ -32,6 +32,14 @@ class ServingConfig(ConfigModel):
     kv_block_size: int = C.SERVING_KV_BLOCK_SIZE_DEFAULT
     num_kv_blocks: int = C.SERVING_NUM_KV_BLOCKS_DEFAULT
     max_batch_slots: int = C.SERVING_MAX_BATCH_SLOTS_DEFAULT
+    # chunked prefill: prompt tokens processed per iteration alongside
+    # the live decode slots (also the mixed program's compiled chunk
+    # width — bigger chunks prefill faster but add VMEM pressure and
+    # lengthen the iterations they ride, raising inter-token latency)
+    prefill_chunk_tokens: int = C.SERVING_PREFILL_CHUNK_TOKENS_DEFAULT
+    # content-addressed prefix caching (RadixAttention-style): shared or
+    # resubmitted prefixes reuse pool blocks instead of re-prefilling
+    prefix_cache: bool = C.SERVING_PREFIX_CACHE_DEFAULT
 
     @model_validator(mode="after")
     def _validate(self):
@@ -47,6 +55,10 @@ class ServingConfig(ConfigModel):
             raise ValueError(
                 f"serving.max_batch_slots must be >= 1, got "
                 f"{self.max_batch_slots}")
+        if self.prefill_chunk_tokens < 1:
+            raise ValueError(
+                f"serving.prefill_chunk_tokens must be >= 1, got "
+                f"{self.prefill_chunk_tokens}")
         return self
 
 

@@ -1,29 +1,38 @@
 """Continuous-batching serving engine: device half of the subsystem.
 
 Couples the host-side policy (``scheduler.py`` + ``block_allocator.py``)
-to three compiled programs:
+to ONE compiled program:
 
-  * **prefill** (one per padded prompt length): dense-cache forward of a
-    request's prefix, scatter of the resulting KV rows into the paged
-    pool at the slot's block table, first-token sample.  Runs once per
-    (re-)admission, off the steady-state path.
-  * **decode step** (compiled exactly ONCE — the acceptance test pins
-    the build counter): one token for every slot in one program.  Slot
-    liveness travels in the per-slot length vector, so requests join
-    and leave between iterations without changing any program shape.
-  * pools are donated back into each program, so on TPU the decode loop
-    re-dispatches one compiled program over the same HBM buffers — the
-    iteration-level-scheduling analogue of the CUDA-graph replay the
-    reference gets from `inference/engine.py:493`.
+  * **mixed step** (compiled exactly ONCE — the acceptance test pins the
+    build counter): every iteration it takes one decode token for each
+    live slot AND up to ``prefill_chunk_tokens`` tokens of a single
+    prompt chunk, scattering the chunk's KV into the slot's pool blocks
+    and sampling a first token when the chunk completes a prefix
+    (Sarathi-Serve-style chunked prefill).  Slot liveness and chunk
+    placement travel as data (length vectors, block tables, scalars),
+    so the program shape is independent of the prompt-length
+    distribution — no per-padded-length prefill family, no retrace as
+    requests join and leave.
+  * **prefix caching** (RadixAttention-style): admission takes
+    content-hash hits against the paged pool, so shared-prefix and
+    preempted-then-resubmitted requests skip straight to their uncached
+    tail; the allocator parks freed-but-registered blocks in an LRU
+    until capacity pressure evicts them.
+  * pools are donated back into each dispatch, so on TPU the serving
+    loop re-dispatches one compiled program over the same HBM buffers —
+    the iteration-level-scheduling analogue of the CUDA-graph replay
+    the reference gets from `inference/engine.py:493`.
 
 Observability (PR-3 layer): queue-depth / batch-occupancy / blocks-in-
-use gauges, TTFT + inter-token-latency histograms, token + preemption
-counters — all under ``dstpu_serving_*`` (docs/serving.md lists them).
+use / cached-blocks gauges, TTFT + inter-token-latency histograms,
+token + preemption + prefix-cache hit/evict counters — all under
+``dstpu_serving_*`` (docs/serving.md lists them).
 """
 from __future__ import annotations
 
+import contextlib
 import time
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +51,8 @@ class ServingEngine:
 
         eng = deepspeed_tpu.init_inference(model, config={
             "serving": {"enabled": True, "kv_block_size": 16,
-                        "num_kv_blocks": 512, "max_batch_slots": 8}})
+                        "num_kv_blocks": 512, "max_batch_slots": 8,
+                        "prefill_chunk_tokens": 256}})
         srv = eng.serving_engine()
         reqs = [srv.submit(p, max_new_tokens=64) for p in prompts]
         srv.run()                      # drain
@@ -50,9 +60,10 @@ class ServingEngine:
 
     Sampling uses the inference config's ``temperature``/``top_k``/
     ``top_p`` (temperature 0 = greedy).  Greedy streams are identical
-    to per-request ``generate()`` — the integration test pins it;
-    stochastic sampling draws from the serving engine's own rng stream,
-    so it matches ``generate`` in distribution, not token-for-token.
+    to per-request ``generate()`` — the integration test pins it, with
+    prefix caching and chunked prefill both on; stochastic sampling
+    draws from the serving engine's own rng stream, so it matches
+    ``generate`` in distribution, not token-for-token.
     """
 
     def __init__(self, engine, rng: Optional[jax.Array] = None):
@@ -67,10 +78,12 @@ class ServingEngine:
         self.model = model
         self.block_size = cfg.kv_block_size
         self.num_slots = cfg.max_batch_slots
+        self.chunk_tokens = cfg.prefill_chunk_tokens
         self.max_pages = max(
             1, -(-engine.config.max_out_tokens // self.block_size))
-        self.allocator = PagedBlockAllocator(cfg.num_kv_blocks,
-                                             self.block_size)
+        self.allocator = PagedBlockAllocator(
+            cfg.num_kv_blocks, self.block_size,
+            enable_prefix_cache=cfg.prefix_cache)
         self.scheduler = ContinuousBatchingScheduler(
             self.num_slots, self.allocator, self.max_pages)
         pools = model.init_paged_cache(cfg.num_kv_blocks, self.block_size,
@@ -81,18 +94,20 @@ class ServingEngine:
             f"serving: paged KV pool {cfg.num_kv_blocks} x "
             f"{self.block_size}-token blocks "
             f"({kv_bytes / 2**20:.1f} MiB), {self.num_slots} decode "
-            f"slots, {self.max_pages} pages/seq")
+            f"slots, {self.max_pages} pages/seq, prefill chunk "
+            f"{self.chunk_tokens} tokens, prefix cache "
+            f"{'on' if cfg.prefix_cache else 'off'}")
 
         self.temperature = engine.config.temperature
         self.top_k = engine.config.top_k
         self.top_p = engine.config.top_p
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
 
-        #: incremented at TRACE time inside the decode program — the
-        #: "compiled decode step traces exactly once" acceptance pin
+        #: incremented at TRACE time inside the mixed program — the
+        #: "the serving loop compiles exactly one program, whatever the
+        #: prompt-length distribution" acceptance pin
         self.decode_builds = 0
-        self._decode_fn = None
-        self._prefill_fns: Dict[int, Any] = {}
+        self._step_fn = None
         # donation keeps the pools in-place on TPU; the CPU backend
         # does not implement donation and would warn every dispatch
         self._donate = jax.default_backend() == "tpu"
@@ -105,9 +120,12 @@ class ServingEngine:
             "decode-slot occupancy (continuous batch size)")
         self._m_blocks = reg.gauge(
             "dstpu_serving_kv_blocks_in_use", "paged KV pool blocks held")
+        self._m_cached = reg.gauge(
+            "dstpu_serving_cached_kv_blocks",
+            "refcount-0 pool blocks parked in the prefix-cache LRU")
         self._m_ttft = reg.histogram(
             "dstpu_serving_ttft_seconds",
-            "submit -> first token (includes queueing + prefill)")
+            "submit -> first token (includes queueing + chunked prefill)")
         self._m_itl = reg.histogram(
             "dstpu_serving_inter_token_seconds",
             "decode-iteration wall time (per-token latency of every "
@@ -116,8 +134,22 @@ class ServingEngine:
             "dstpu_serving_tokens_total", "tokens generated by serving")
         self._m_preempt = reg.counter(
             "dstpu_serving_preemptions_total",
-            "sequences evicted on KV-pool pressure (recompute on "
+            "sequences evicted on KV-pool pressure (tail recompute on "
             "re-admission)")
+        self._m_hit_tokens = reg.counter(
+            "dstpu_serving_prefix_cache_hit_tokens_total",
+            "prompt tokens served from cached KV blocks (prefill skipped)")
+        self._m_prefill_tokens = reg.counter(
+            "dstpu_serving_prefill_tokens_total",
+            "prompt tokens actually computed by chunked prefill "
+            "(the prefix-cache miss side)")
+        self._m_evictions = reg.counter(
+            "dstpu_serving_prefix_cache_evictions_total",
+            "cached blocks evicted from the LRU under capacity pressure")
+        # counter deltas are polled off the (jax-free) allocator's
+        # cumulative ints
+        self._hits_polled = 0
+        self._evictions_polled = 0
 
     # ------------------------------------------------------------------
     # request intake
@@ -137,54 +169,31 @@ class ServingEngine:
         return req
 
     # ------------------------------------------------------------------
-    # compiled programs
+    # the one compiled program
     # ------------------------------------------------------------------
-    def _build_prefill(self, padded_len: int):
-        engine, model = self.engine, self.model
-        npages = padded_len // self.block_size
-        bs = self.block_size
-
-        def prefill(params, scales, pool_k, pool_v, ids, true_len, pages,
-                    rng):
-            mp = engine._model_params(params, scales)
-            cache = model.init_cache(1, padded_len, dtype=engine.dtype)
-            logits, cache = model.apply(mp, ids, cache=cache)
-            # cache rows [L, 1, padded, kvh, hd] -> [L, npages, bs, ...]
-            def scatter(pool, rows):
-                rows = rows[:, 0].reshape(rows.shape[0], npages, bs,
-                                          *rows.shape[3:])
-                return pool.at[:, pages].set(rows.astype(pool.dtype))
-            pool_k = scatter(pool_k, cache["k"])
-            pool_v = scatter(pool_v, cache["v"])
-            last = jax.lax.dynamic_slice_in_dim(
-                logits, true_len - 1, 1, axis=1)[:, 0]
-            rng, sub = jax.random.split(rng)
-            tok = engine._sample(last, sub, self.temperature, self.top_k,
-                                 self.top_p)
-            return tok[0].astype(jnp.int32), pool_k, pool_v, rng
-
-        get_registry().counter("dstpu_jit_programs_built_total").inc()
-        with self.engine.mesh:
-            return jax.jit(
-                prefill,
-                donate_argnums=(2, 3) if self._donate else ())
-
-    def _build_decode(self):
+    def _build_step(self):
         engine, model = self.engine, self.model
 
-        def step(params, scales, pool_k, pool_v, tables, lens, tokens,
-                 rng):
+        def step(params, scales, pool_k, pool_v, tables, lens,
+                 dec_tokens, dec_active, chunk_ids, chunk_slot,
+                 chunk_start, chunk_len, rng):
             # trace-time side effect: counts program BUILDS, not calls —
             # continuous batching must never retrace this
             self.decode_builds += 1
             mp = engine._model_params(params, scales)
             cache = {"k": pool_k, "v": pool_v, "block_tables": tables,
                      "lens": lens}
-            logits, cache = model.apply(mp, tokens[:, None], cache=cache)
-            rng, sub = jax.random.split(rng)
-            nxt = engine._sample(logits[:, -1], sub, self.temperature,
+            dec_logits, chunk_logits, cache = model._apply_paged_mixed(
+                mp, cache, dec_tokens, dec_active, chunk_ids, chunk_slot,
+                chunk_start, chunk_len)
+            rng, s_dec, s_first = jax.random.split(rng, 3)
+            nxt = engine._sample(dec_logits, s_dec, self.temperature,
                                  self.top_k, self.top_p)
-            return nxt.astype(jnp.int32), cache["k"], cache["v"], rng
+            first = engine._sample(chunk_logits[None], s_first,
+                                   self.temperature, self.top_k,
+                                   self.top_p)[0]
+            return (nxt.astype(jnp.int32), first.astype(jnp.int32),
+                    cache["k"], cache["v"], rng)
 
         get_registry().counter("dstpu_jit_programs_built_total").inc()
         with self.engine.mesh:
@@ -194,79 +203,113 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # one scheduler iteration
     # ------------------------------------------------------------------
-    def _prefill_request(self, slot: int, req: Request) -> None:
-        prefix = req.prefix
-        p_len = len(prefix)
-        padded = -(-p_len // self.block_size) * self.block_size
-        npages = padded // self.block_size
-        fn = self._prefill_fns.get(padded)
-        if fn is None:
-            fn = self._prefill_fns[padded] = self._build_prefill(padded)
-        ids = np.zeros((1, padded), np.int32)
-        ids[0, :p_len] = prefix
-        pages = np.asarray(
-            self.allocator.block_table(req.req_id)[:npages], np.int32)
-        with trace_span("serving/prefill", slot=slot, tokens=p_len):
-            tok, self._pool_k, self._pool_v, self._rng = fn(
-                self.engine.params, getattr(self.engine, "_scales", None),
-                self._pool_k, self._pool_v, ids,
-                jnp.asarray(p_len, jnp.int32), pages, self._rng)
-            tok = int(tok)
-        req.cached_tokens = p_len
-        req.output.append(tok)
-        if req.first_token_time is None:
-            req.first_token_time = time.perf_counter()
-            self._m_ttft.observe(req.first_token_time - req.submit_time)
-        self._m_tokens.inc()
-        if req.done:
-            self.scheduler.finish(slot)
+    def _dispatch(self, dec: List[Tuple[int, Request]],
+                  chunk: Optional[Tuple[int, Request, int, int]]) -> None:
+        """One dispatch of the mixed program: a decode token for every
+        slot in ``dec`` plus (optionally) one prompt chunk, then apply
+        the results to the scheduler's request records."""
+        sched = self.scheduler
+        tables = np.zeros((self.num_slots, self.max_pages), np.int32)
+        lens = np.zeros((self.num_slots,), np.int32)
+        dec_tokens = np.zeros((self.num_slots,), np.int32)
+        dec_active = np.zeros((self.num_slots,), np.int32)
+        for slot, req in sched.running.items():
+            table = self.allocator.block_table(req.req_id)
+            tables[slot, :len(table)] = table
+            lens[slot] = req.cached_tokens
+        for slot, req in dec:
+            dec_active[slot] = 1
+            dec_tokens[slot] = req.output[-1]
+        chunk_ids = np.zeros((self.chunk_tokens,), np.int32)
+        c_slot = c_start = c_len = 0
+        if chunk is not None:
+            c_slot, req, c_start, c_len = chunk[0], chunk[1], chunk[2], \
+                chunk[3]
+            chunk_ids[:c_len] = req.prefix[c_start:c_start + c_len]
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        t0 = time.perf_counter()
+        with contextlib.ExitStack() as spans:
+            if dec:
+                spans.enter_context(
+                    trace_span("serving/decode", batch=len(dec)))
+            if chunk is not None:
+                spans.enter_context(
+                    trace_span("serving/prefill_chunk", slot=c_slot,
+                               start=c_start, tokens=c_len))
+            nxt, first, self._pool_k, self._pool_v, self._rng = \
+                self._step_fn(
+                    self.engine.params,
+                    getattr(self.engine, "_scales", None),
+                    self._pool_k, self._pool_v, tables, lens, dec_tokens,
+                    dec_active, chunk_ids,
+                    jnp.asarray(c_slot, jnp.int32),
+                    jnp.asarray(c_start, jnp.int32),
+                    jnp.asarray(c_len, jnp.int32), self._rng)
+            nxt = np.asarray(nxt)
+        if dec:
+            self._m_itl.observe(time.perf_counter() - t0)
+            self._m_tokens.inc(len(dec))
+        for slot, req in dec:
+            req.cached_tokens += 1
+            req.output.append(int(nxt[slot]))
+            if req.cached_tokens % self.block_size == 0:
+                # a decode-filled block just completed: register it so a
+                # preemption (or an identical resubmission) stays warm
+                self.allocator.commit_cached(req.req_id, req.prefix,
+                                             req.cached_tokens)
+            if req.done:
+                sched.finish(slot)
+        if chunk is not None:
+            req = chunk[1]
+            req.cached_tokens += c_len
+            self._m_prefill_tokens.inc(c_len)
+            self.allocator.commit_cached(req.req_id, req.prefix,
+                                         req.cached_tokens)
+            if req.cached_tokens >= req.prefill_target:
+                # the chunk that completed the prefix carries the first
+                # token (sampled from its last valid position)
+                req.output.append(int(first))
+                self._m_tokens.inc()
+                if req.first_token_time is None:
+                    req.first_token_time = time.perf_counter()
+                    self._m_ttft.observe(
+                        req.first_token_time - req.submit_time)
+                if req.done:
+                    sched.finish(chunk[0])
 
     def step(self) -> bool:
-        """One continuous-batching iteration: admit, guarantee KV
-        capacity, decode one token for every active slot, retire
-        finished streams.  Returns True while work remains."""
+        """One continuous-batching iteration: admit (taking prefix-cache
+        hits), guarantee KV capacity, then dispatch the mixed program —
+        one decode token for every live slot riding alongside up to
+        ``prefill_chunk_tokens`` of prompt chunks.  Returns True while
+        work remains."""
         sched = self.scheduler
         # capacity BEFORE admission: running sequences claim their next
         # block first, so a fresh admission is never immediately chosen
-        # as the LIFO preemption victim (which would discard the prefill
+        # as the preemption victim (which would discard the prefill
         # it just paid for)
         for req in sched.ensure_decode_capacity():
             self._m_preempt.inc()
             logger.info(f"serving: preempted {req.req_id} on KV pressure "
                         f"({req.preemptions} time(s))")
-        for slot, req in sched.schedule_admissions():
-            self._prefill_request(slot, req)
+        sched.schedule_admissions()
         self._update_gauges()
 
-        active = [(slot, sched.running[slot])
-                  for slot in sorted(sched.running)]
-        if active:
-            tables = np.zeros((self.num_slots, self.max_pages), np.int32)
-            lens = np.zeros((self.num_slots,), np.int32)
-            tokens = np.zeros((self.num_slots,), np.int32)
-            for slot, req in active:
-                table = self.allocator.block_table(req.req_id)
-                tables[slot, :len(table)] = table
-                lens[slot] = req.cached_tokens
-                tokens[slot] = req.output[-1]
-            if self._decode_fn is None:
-                self._decode_fn = self._build_decode()
-            t0 = time.perf_counter()
-            with trace_span("serving/decode", batch=len(active)):
-                nxt, self._pool_k, self._pool_v, self._rng = \
-                    self._decode_fn(
-                        self.engine.params,
-                        getattr(self.engine, "_scales", None),
-                        self._pool_k, self._pool_v, tables, lens, tokens,
-                        self._rng)
-                nxt = np.asarray(nxt)
-            self._m_itl.observe(time.perf_counter() - t0)
-            self._m_tokens.inc(len(active))
-            for slot, req in active:
-                req.cached_tokens += 1
-                req.output.append(int(nxt[slot]))
-                if req.done:
-                    sched.finish(slot)
+        budget = self.chunk_tokens
+        include_decode = True
+        while True:
+            chunk = sched.next_prefill_chunk(budget)
+            dec = sched.decoding_slots() if include_decode else []
+            if not dec and chunk is None:
+                break
+            self._dispatch(dec, chunk)
+            include_decode = False
+            if chunk is None:
+                break
+            budget -= chunk[3]
+            if budget <= 0:
+                break
         self._update_gauges()
         return sched.has_work
 
@@ -274,6 +317,15 @@ class ServingEngine:
         self._m_queue.set(self.scheduler.queue_depth)
         self._m_active.set(self.scheduler.active_slots)
         self._m_blocks.set(self.allocator.num_used)
+        self._m_cached.set(self.allocator.num_cached)
+        d = self.allocator.hit_tokens_total - self._hits_polled
+        if d:
+            self._m_hit_tokens.inc(d)
+            self._hits_polled += d
+        d = self.allocator.evictions_total - self._evictions_polled
+        if d:
+            self._m_evictions.inc(d)
+            self._evictions_polled += d
 
     def run(self, max_steps: Optional[int] = None) -> List[Request]:
         """Drain the queue; returns the finished requests.  A bounded
@@ -287,7 +339,9 @@ class ServingEngine:
                     f"serving did not drain within {max_steps} steps "
                     f"({self.scheduler.queue_depth} queued, "
                     f"{self.scheduler.active_slots} running)")
-        # a drained pool must hold zero sequence blocks — leak check
+        # a drained pool must hold zero sequence-referenced blocks
+        # (cached-LRU blocks may remain — they are reclaimable capacity,
+        # not leaks) — leak check
         self.allocator.assert_consistent()
         if self.allocator.num_used:
             from .block_allocator import BlockPoolError

@@ -6,17 +6,26 @@ done.  Orca (Yu et al., OSDI '22) made the case that the scheduling
 quantum for LLM serving must be ONE decode iteration — requests join
 and leave the running batch between iterations instead of waiting for
 the whole batch to finish.  Here that batch is a fixed set of
-``num_slots`` decode slots (so the compiled decode step never
-retraces); a slot's liveness is carried by its per-slot length
-(0 = inactive), not by the program shape.
+``num_slots`` decode slots (so the compiled mixed step never retraces);
+a slot's liveness is carried by its per-slot length (0 = inactive), not
+by the program shape.
+
+Chunked prefill (Sarathi-Serve, Agrawal et al.): admission allocates a
+request's blocks and takes its prefix-cache hits, but its prompt is
+COMPUTED in ``prefill_chunk_tokens``-sized chunks that ride the same
+iterations as the live decode slots — a long prompt no longer
+head-of-line-blocks decode for a whole iteration.  A request is
+"prefilling" while ``cached_tokens < prefill_target`` and joins decode
+the iteration after its last chunk lands.
 
 State machine per request::
 
     WAITING --admit--> RUNNING --finish(eos | max_new)--> FINISHED
        ^                  |
        +---- preempt -----+   (KV pressure; re-enters at queue FRONT,
-                               recompute-style: prompt + generated so
-                               far prefill again on re-admission)
+                               recompute-style — but prefix-cache hits
+                               mean re-admission recomputes only the
+                               uncached tail)
 
 Policies (deliberately simple and deterministic, pinned by tests):
 
@@ -24,12 +33,14 @@ Policies (deliberately simple and deterministic, pinned by tests):
     admits iff a slot is free AND the pool covers its prefix + 1
     token.  No skip-ahead, so admission order == submission order and
     token streams are reproducible.
+  * prefill chunking: oldest-admitted prefilling slot first, up to the
+    per-iteration token budget.
   * preemption: when a running sequence crosses a block boundary and
-    the pool is dry, the LATEST-admitted running sequence is evicted
-    (LIFO victim choice — the one that wasted the least work), its
-    blocks are freed, and it re-queues at the front.  Recompute beats
-    swap here: re-prefill is one dense pass, and the paged pool has no
-    host-side swap tier yet.
+    the pool is dry, the LIFO victim (latest admitted — least work
+    wasted) is evicted, preferring a victim whose full blocks are all
+    cache-RESIDENT (its prefix stays hittable, so eviction costs only
+    the tail recompute); its blocks are freed (registered ones park in
+    the allocator's cached LRU) and it re-queues at the front.
 
 Pure Python + the allocator — no jax; the engine owns device state.
 """
@@ -64,9 +75,16 @@ class Request:
         default_factory=lambda: f"req-{next(_req_counter)}")
     state: RequestState = RequestState.WAITING
     output: List[int] = field(default_factory=list)
-    #: tokens whose KV currently sits in the pool (prompt + generated
-    #: minus the newest sampled token, which writes on the next decode)
+    #: tokens whose KV currently sits in the pool (prefix-cache hits +
+    #: computed chunks + decoded tokens, minus the newest sampled token,
+    #: which writes on the next decode)
     cached_tokens: int = 0
+    #: prefix length frozen at (re-)admission: the slot is prefilling
+    #: while cached_tokens < prefill_target
+    prefill_target: int = 0
+    #: cumulative prefix-cache hit tokens across (re-)admissions — the
+    #: prefill work this request never had to pay
+    cache_hit_tokens: int = 0
     preemptions: int = 0
     submit_time: float = field(default_factory=time.perf_counter)
     first_token_time: Optional[float] = None
@@ -74,9 +92,15 @@ class Request:
 
     @property
     def prefix(self) -> List[int]:
-        """What prefill must process on (re-)admission: the prompt plus
-        everything already generated (recompute-style preemption)."""
+        """What prefill must cover on (re-)admission: the prompt plus
+        everything already generated (cache hits then skip whatever is
+        still block-resident)."""
         return list(self.prompt) + list(self.output)
+
+    @property
+    def prefilling(self) -> bool:
+        return self.state is RequestState.RUNNING and \
+            self.cached_tokens < self.prefill_target
 
     @property
     def done(self) -> bool:
@@ -116,6 +140,12 @@ class ContinuousBatchingScheduler:
     def max_tokens_per_seq(self) -> int:
         return self.max_blocks_per_seq * self.alloc.block_size
 
+    def decoding_slots(self) -> List[Tuple[int, Request]]:
+        """Slots that take a decode token this iteration (admitted AND
+        past their prefill), in slot order for deterministic batches."""
+        return [(s, r) for s, r in sorted(self.running.items())
+                if not r.prefilling]
+
     # -- lifecycle ---------------------------------------------------------
     def submit(self, req: Request) -> Request:
         """Queue a request. Validates it can EVER fit (prompt + new
@@ -141,33 +171,68 @@ class ContinuousBatchingScheduler:
 
     def schedule_admissions(self) -> List[Tuple[int, Request]]:
         """FCFS admission into free slots while the pool covers each
-        head request's prefix + 1 decode token.  Returns
-        ``[(slot, request), ...]`` for the engine to prefill."""
+        head request's prefix + 1 decode token.  Allocation takes the
+        request's prefix-cache hits, so a resubmitted or shared-prefix
+        request starts with ``cached_tokens`` already covering its hit
+        blocks and prefills only the tail.  Returns
+        ``[(slot, request), ...]``."""
         admitted: List[Tuple[int, Request]] = []
         while self.waiting and len(self.running) < self.num_slots:
             req = self.waiting[0]
+            # feasibility counts only blocks allocation would take from
+            # free capacity: hits on LIVE shared blocks are free, so
+            # concurrent shared-prefix requests admit together instead
+            # of serializing behind a full-prefix capacity demand.  The
+            # probe's hash walk is skipped while the full demand fits
+            # outright, so an unpressured (or uncached-and-blocked)
+            # head costs no per-iteration rehash of its prefix.
             need = self.alloc.blocks_for_tokens(len(req.prefix) + 1)
+            if not self.alloc.can_allocate(need):
+                need = self.alloc.probe_fresh_need(len(req.prefix) + 1,
+                                                   req.prefix)
             if not self.alloc.can_allocate(need):
                 break                      # head-of-line blocks: FCFS order
             self.waiting.popleft()
             slot = min(set(range(self.num_slots)) - set(self.running))
-            self.alloc.allocate(req.req_id, len(req.prefix) + 1)
+            _, cached = self.alloc.allocate(
+                req.req_id, len(req.prefix) + 1, token_ids=req.prefix)
             req.state = RequestState.RUNNING
-            req.cached_tokens = 0          # prefill pending
+            req.prefill_target = len(req.prefix)
+            req.cached_tokens = cached     # hit blocks skip prefill
+            req.cache_hit_tokens += cached
             self.running[slot] = req
             self._admit_order.append(slot)
             admitted.append((slot, req))
         return admitted
 
+    def next_prefill_chunk(self, budget: int
+                           ) -> Optional[Tuple[int, Request, int, int]]:
+        """The next prompt chunk to compute under the per-iteration
+        token ``budget``: oldest-admitted prefilling slot, at most
+        ``budget`` tokens of its remaining prefix.  Returns
+        ``(slot, request, start_row, n_tokens)`` or None."""
+        if budget < 1:
+            return None
+        for slot in self._admit_order:
+            req = self.running.get(slot)
+            if req is None or not req.prefilling:
+                continue
+            n = min(budget, req.prefill_target - req.cached_tokens)
+            return slot, req, req.cached_tokens, n
+        return None
+
     def ensure_decode_capacity(self) -> List[Request]:
-        """Before a decode iteration: every running sequence must own a
-        block for its next write position.  Grows tables; on pool
-        exhaustion preempts latest-admitted sequences (possibly the one
-        asking) until the rest fit.  Returns the preempted requests."""
+        """Before a decode iteration: every DECODING sequence must own a
+        block for its next write position (prefilling slots were fully
+        covered at admission).  Grows tables; on pool exhaustion
+        preempts until the rest fit — LIFO order, but preferring a
+        victim whose blocks stay cache-resident (eviction then costs
+        only its uncached tail on re-admission).  Returns the preempted
+        requests."""
         preempted: List[Request] = []
         for slot in list(self._admit_order):           # oldest first
             req = self.running.get(slot)
-            if req is None:
+            if req is None or req.prefilling:
                 continue
             while True:
                 need = self.alloc.blocks_for_tokens(req.cached_tokens + 1)
@@ -177,7 +242,7 @@ class ContinuousBatchingScheduler:
                 try:
                     self.alloc.append_block(req.req_id)
                 except BlockPoolError:
-                    victim_slot = self._admit_order[-1]
+                    victim_slot = self._pick_victim()
                     victim = self.running[victim_slot]
                     self._preempt(victim_slot, victim)
                     preempted.append(victim)
@@ -185,12 +250,33 @@ class ContinuousBatchingScheduler:
                         break              # evicted itself; next slot
         return preempted
 
+    def _pick_victim(self) -> int:
+        """LIFO preemption, cache-residency-aware: walk latest-admitted
+        first and take the first victim whose full blocks are all
+        registered in the prefix cache (freeing them parks the prefix
+        in the cached LRU, so the victim's re-admission recomputes only
+        its tail).  Falls back to the plain latest-admitted slot.  With
+        the prefix cache disabled nothing is ever registered, so the
+        walk would reduce to "prefer whoever holds zero full blocks" —
+        inverting LIFO against older short-prompt requests; skip it."""
+        if self.alloc.enable_prefix_cache:
+            for slot in reversed(self._admit_order):
+                req = self.running[slot]
+                if self.alloc.is_cache_resident(req.req_id,
+                                                req.cached_tokens):
+                    return slot
+        return self._admit_order[-1]
+
     def _preempt(self, slot: int, req: Request) -> None:
+        # register what was computed before letting the blocks go: the
+        # re-admission (and any shared-prefix sibling) hits them
+        self.alloc.commit_cached(req.req_id, req.prefix, req.cached_tokens)
         self.alloc.free(req.req_id)
         del self.running[slot]
         self._admit_order.remove(slot)
         req.state = RequestState.WAITING
         req.cached_tokens = 0
+        req.prefill_target = 0
         req.preemptions += 1
         self.preemption_count += 1
         # front of the queue, so the original admission order is preserved
@@ -199,6 +285,10 @@ class ContinuousBatchingScheduler:
     def finish(self, slot: int) -> Request:
         req = self.running.pop(slot)
         self._admit_order.remove(slot)
+        # a finished request's blocks park in the cached LRU — the next
+        # request over the same system prompt / few-shot template hits
+        # them instead of re-prefilling
+        self.alloc.commit_cached(req.req_id, req.prefix, req.cached_tokens)
         self.alloc.free(req.req_id)
         req.state = RequestState.FINISHED
         req.finish_time = time.perf_counter()

@@ -52,6 +52,35 @@ class PagedKVCache(NamedTuple):
     lens: Any
 
 
+class PagedMixedState(NamedTuple):
+    """Paged serving state for the MIXED decode+chunked-prefill step
+    (Sarathi-Serve style) — ``_attention`` dispatches on it when the
+    serving engine coalesces one prompt chunk with the live decode
+    slots into a single compiled program.
+
+    On top of :class:`PagedKVCache`'s pool/tables/lens:
+
+      dec_active   [B] int32 — 1 for slots decoding this iteration
+                   (prefilling and empty slots are 0: their row of the
+                   token batch is ignored and their KV write re-routes
+                   to the null block)
+      chunk_slot   int32 scalar — slot whose prompt chunk rides this
+                   step (any value when chunk_len == 0)
+      chunk_start  int32 scalar — absolute row of the chunk's first
+                   token (== rows already present for that slot)
+      chunk_len    int32 scalar — valid chunk tokens (0 = no prefill
+                   work this dispatch)
+    """
+    k_pool: Any
+    v_pool: Any
+    block_tables: Any
+    lens: Any
+    dec_active: Any
+    chunk_slot: Any
+    chunk_start: Any
+    chunk_len: Any
+
+
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
     vocab_size: int = 50304
@@ -540,6 +569,11 @@ class TransformerLM:
 
         new_cache = None
         offset = 0
+        if isinstance(cache_kv, PagedMixedState):
+            # continuous batching, mixed step: decode slots + one prompt
+            # chunk in a single program (chunked prefill)
+            return self._paged_mixed_attention(p, q, k, v, cache_kv, t, nh,
+                                               hd)
         if isinstance(cache_kv, PagedKVCache):
             # continuous-batching decode: per-slot write into the shared
             # block pool + batched paged-attention kernel
@@ -715,6 +749,64 @@ class TransformerLM:
             jnp.where(lens > 0, lens + 1, 0), tables,
             sm_scale=self._attn_scale)
         o = o.reshape(b, t, nh * hd)
+        return L.dense_apply(p["out"], o), (pool_k, pool_v)
+
+    def _paged_mixed_attention(self, p, q, k, v, st: PagedMixedState, t,
+                               nh, hd):
+        """One layer of the mixed decode+chunked-prefill step.
+
+        q/k/v arrive as ``[1, B + C, nh|kvh, hd]`` — the first B rows
+        are each decode slot's new token, the last C rows are one
+        slot's prompt chunk; rotary was already applied with per-row
+        positions.  Both groups scatter their k/v into the pool in one
+        combined write (decode rows at ``table[len // blk]``, chunk
+        rows at ``base + i`` of the chunk slot's table; inactive/padded
+        rows re-route to the reserved null block), then two kernels
+        attend — the batched decode kernel over all slots and the
+        causal chunk kernel over the chunk slot's pages — and the
+        outputs concatenate back into the shared projection."""
+        pool_k, pool_v, tables, lens = (st.k_pool, st.v_pool,
+                                        st.block_tables, st.lens)
+        bsl = lens.shape[0]                   # decode slots
+        c = t - bsl                           # chunk width
+        nb, blk = pool_k.shape[0], pool_k.shape[1]
+        npages = tables.shape[1]
+        act = st.dec_active > 0
+        slot = jnp.arange(bsl)
+        # decode rows: write position of each slot's new token (null
+        # block row 0 for slots not decoding this iteration)
+        wd = jnp.where(act, tables[slot, lens // blk] * blk + lens % blk,
+                       0)
+        # chunk rows: absolute rows base..base+C-1 of the chunk slot's
+        # table (null block for padding past chunk_len)
+        ci = jnp.arange(c)
+        cpos = st.chunk_start + ci
+        ctable = tables[st.chunk_slot]
+        cpage = jnp.minimum(cpos // blk, npages - 1)
+        wc = jnp.where(ci < st.chunk_len, ctable[cpage] * blk + cpos % blk,
+                       0)
+        write = jnp.concatenate([wd, wc])
+        flat = (nb * blk,) + pool_k.shape[2:]
+        pool_k = pool_k.reshape(flat).at[write].set(
+            k[0].astype(pool_k.dtype)).reshape(pool_k.shape)
+        pool_v = pool_v.reshape(flat).at[write].set(
+            v[0].astype(pool_v.dtype)).reshape(pool_v.shape)
+        from ..ops.transformer.paged_decode_attention import (
+            paged_decode_attention, paged_prefill_attention)
+        pk = pool_k.astype(q.dtype)
+        pv = pool_v.astype(q.dtype)
+        o_dec = paged_decode_attention(
+            q[0, :bsl], pk, pv,
+            # only slots decoding THIS iteration attend (their length
+            # includes the just-written token); prefilling and empty
+            # slots are masked to zero rows
+            jnp.where(act, lens + 1, 0), tables,
+            sm_scale=self._attn_scale)
+        o_chunk = paged_prefill_attention(
+            q[0, bsl:], pk, pv, st.chunk_start, st.chunk_len, ctable,
+            sm_scale=self._attn_scale)
+        o = jnp.concatenate([o_dec, o_chunk], axis=0)[None]
+        o = o.reshape(1, t, nh * hd)
         return L.dense_apply(p["out"], o), (pool_k, pool_v)
 
     def _mlp(self, p, x):
@@ -1027,6 +1119,63 @@ class TransformerLM:
         new_cache = {"k": nk, "v": nv, "block_tables": tables,
                      "lens": jnp.where(lens > 0, lens + 1, 0)}
         return self._project(params, x), new_cache
+
+    def _apply_paged_mixed(self, params, cache, dec_tokens, dec_active,
+                           chunk_ids, chunk_slot, chunk_start, chunk_len):
+        """Mixed continuous-batching step: one decode token per active
+        slot PLUS one ``chunk_ids``-sized chunk of a single slot's
+        prompt, in ONE program (Sarathi-Serve chunked prefill — the
+        prefill never monopolizes an iteration and the program shape is
+        independent of the prompt-length distribution).
+
+        ``cache``: {"k"/"v": [L, num_blocks, block, kv_heads, hd] pools,
+        "block_tables": [B, pages] int32, "lens": [B] int32 (rows
+        already in the pool per slot)}.  ``dec_tokens``/``dec_active``
+        [B] int32; ``chunk_ids`` [C] int32 (padded with anything past
+        ``chunk_len``); ``chunk_slot``/``chunk_start``/``chunk_len``
+        int32 scalars.  Returns ``(dec_logits [B, V], chunk_logits [V]
+        — the chunk's LAST VALID position, the first-token sample point
+        when the chunk completes a prefix, new_cache)``."""
+        reason = self._paged_supported()
+        if reason is not None:
+            raise NotImplementedError(reason)
+        tables, lens = cache["block_tables"], cache["lens"]
+        bsl = dec_tokens.shape[0]
+        c = chunk_ids.shape[0]
+        ci = jnp.arange(c)
+        # clamp padded chunk positions to 0: base + i past chunk_len can
+        # exceed the rotary/learned position tables near max_seq_len
+        cpos = jnp.where(ci < chunk_len, chunk_start + ci, 0)
+        positions = jnp.concatenate([lens, cpos])[None]    # [1, B+C]
+        ids = jnp.concatenate([dec_tokens, chunk_ids])[None]
+        x = self._embed_tokens(params, ids, positions=positions)
+        st_args = (tables, lens, dec_active, chunk_slot, chunk_start,
+                   chunk_len)
+
+        def scan_fn(carry, xs):
+            bp, pk, pv = xs
+            bp = self.block_transform(bp)
+            y, (npk, npv) = self._block(
+                bp, carry, PagedMixedState(pk, pv, *st_args), positions)
+            return y, (npk, npv)
+
+        x, (nk, nv) = jax.lax.scan(scan_fn, x,
+                                   (params["blocks"], cache["k"],
+                                    cache["v"]))
+        if self.config.final_layernorm:
+            x = self._norm_fn()(params["ln_f"], x)
+        # project only the rows anything samples from: the B decode rows
+        # and the chunk's last valid position (a [B+1, V] head instead
+        # of [B+C, V])
+        last = jax.lax.dynamic_slice_in_dim(
+            x[0], bsl + jnp.maximum(chunk_len - 1, 0), 1, axis=0)
+        logits = self._project(params,
+                               jnp.concatenate([x[0, :bsl], last])[None])
+        new_lens = lens + (dec_active > 0).astype(lens.dtype)
+        new_lens = new_lens.at[chunk_slot].add(chunk_len)
+        new_cache = {"k": nk, "v": nv, "block_tables": tables,
+                     "lens": new_lens}
+        return logits[0, :bsl], logits[0, bsl], new_cache
 
     def init_paged_cache(self, num_blocks: int, block_size: int,
                          dtype=None) -> Dict:

@@ -7,9 +7,7 @@ between both).  Kernels import :func:`compiler_params` instead of
 touching either class so the same source runs on every jax this repo
 meets (laptop CPU CI on 0.4.x, the tunnel's newer TPU build).
 
-New kernels should route through here; the pre-existing kernels still
-spell ``pltpu.CompilerParams`` directly and can migrate when their
-suites are next touched.
+All in-tree kernels route through here; new ones should too.
 """
 from __future__ import annotations
 

@@ -30,6 +30,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..pallas_compat import compiler_params
+
 MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
 LANES = 128
 
@@ -150,7 +152,7 @@ def _fwd(q, k, v, idx, cnt, causal, sm_scale, block, nheads, interpret):
             jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct((bh, 8, tq), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(idx, cnt, q, k, v)
@@ -277,7 +279,7 @@ def _bwd(causal, sm_scale, block, nheads, layout_c, interpret, res, do):
             scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(idx, cnt, q, k, v, do, lse, delta)
@@ -317,7 +319,7 @@ def _bwd(causal, sm_scale, block, nheads, layout_c, interpret, res, do):
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(idxT, cntT, q, k, v, do, lse, delta)

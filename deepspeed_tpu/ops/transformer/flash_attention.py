@@ -30,6 +30,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..pallas_compat import compiler_params
+
 MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
 LANES = 128
 
@@ -123,7 +125,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         # accumulation dim. Mosaic needs this to double-buffer block DMAs
         # across grid steps — without it the kernel runs DMA-serial and
         # sits at <10% of the MXU.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
@@ -272,7 +274,7 @@ def _bwd_fused(causal, sm_scale, interpret, q, k, v, do, lse, delta):
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compiler_params(
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
@@ -307,7 +309,7 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
@@ -336,7 +338,7 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse, delta)

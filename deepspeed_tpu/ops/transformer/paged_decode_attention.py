@@ -180,6 +180,168 @@ def paged_decode_attention(q: jnp.ndarray, pool_k: jnp.ndarray,
     return out
 
 
+def _prefill_kernel(meta_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                    m_scr, l_scr, acc_scr, *, sm_scale, block):
+    """Causal multi-token chunk attention over one slot's pages.
+
+    Grid ``(kv_head, page)``.  q_ref [G, C, D] (this kv head's query
+    group, rotary already applied); k_ref/v_ref [block, D] (this kv
+    head's slice of the page the index_map selected via the block
+    table); o_ref [G, C, D]; scratch m/l [G, C], acc [G, C, D].
+    ``meta_ref`` carries [base, total_len]: queries sit at absolute
+    rows base..base+C-1, rows below ``base`` are prior context (fully
+    visible), causality applies inside the chunk, and nothing at or
+    past ``total_len`` is attended."""
+    p = pl.program_id(1)
+    npages = pl.num_programs(1)
+    base, total = meta_ref[0], meta_ref[1]
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(p * block < total)
+    def _body():
+        q = q_ref[...].astype(jnp.float32)            # [G, C, D]
+        k = k_ref[...].astype(jnp.float32)            # [block, D]
+        scores = jax.lax.dot_general(
+            q, k, (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # [G, C, block]
+        pos = p * block + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 2)
+        qpos = base + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        scores = jnp.where((pos <= qpos) & (pos < total), scores,
+                           MASK_VALUE)
+        m_prev = m_scr[...]                           # [G, C]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)               # [G, C]
+        probs = jnp.exp(scores - m_new[..., None])    # [G, C, block]
+        l_scr[...] = alpha * l_scr[...] + jnp.sum(probs, axis=-1)
+        v = v_ref[...].astype(jnp.float32)            # [block, D]
+        pv = jax.lax.dot_general(
+            probs, v, (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [G, C, D]
+        # alpha indexes the leading (sublane) dims and broadcasts over
+        # the lane dim — no relayout (unlike the decode kernel's [1, H]
+        # lane-vector, which needs the diag-matmul trick)
+        acc_scr[...] = alpha[..., None] * acc_scr[...] + pv
+        m_scr[...] = m_new
+
+    @pl.when(p == npages - 1)
+    def _out():
+        # a zero-length chunk (idle prefill lane in the mixed program)
+        # never ran a page: l stays 0 and the clamp yields zero rows
+        inv = 1.0 / jnp.maximum(l_scr[...], 1e-30)    # [G, C]
+        o_ref[...] = (inv[..., None] * acc_scr[...]).astype(o_ref.dtype)
+
+
+def paged_prefill_attention(q: jnp.ndarray, pool_k: jnp.ndarray,
+                            pool_v: jnp.ndarray, base: jnp.ndarray,
+                            chunk_len: jnp.ndarray,
+                            block_table: jnp.ndarray,
+                            sm_scale: Optional[float] = None,
+                            interpret: Optional[bool] = None
+                            ) -> jnp.ndarray:
+    """Causal chunked-prefill attention for ONE slot through its block
+    table (the Sarathi-Serve mixed-batch building block).
+
+    q [C, H, D] — a chunk of C query tokens at absolute rows
+    ``base .. base+C-1`` (rotary already applied); pool_k/v
+    [num_blocks, block, Hkv, D]; ``base`` int32 scalar (rows of prior
+    context already in the pool); ``chunk_len`` int32 scalar (valid
+    queries; rows past it are padding — finite garbage out, callers
+    ignore them); block_table [pages] int32 (the slot's pages, padded
+    with the reserved null block 0).  The chunk's OWN k/v must already
+    be scattered into the pool at rows base.. (the model does this
+    immediately before the call), so the kernel reads every key — prior
+    and in-chunk — through one uniform page walk.  Returns [C, H, D].
+    """
+    c, h, d = q.shape
+    nb, block, hkv = pool_k.shape[0], pool_k.shape[1], pool_k.shape[2]
+    if pool_v.shape != pool_k.shape:
+        raise ValueError(f"pool_k {pool_k.shape} != pool_v {pool_v.shape}")
+    if h % hkv:
+        raise ValueError(f"query heads {h} not a multiple of kv heads {hkv}")
+    if block_table.ndim != 1:
+        raise ValueError(
+            f"block_table must be [pages], got {block_table.shape}")
+    groups = h // hkv
+    npages = block_table.shape[0]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = _interpret_default()
+    total = jnp.asarray(base, jnp.int32) + jnp.asarray(chunk_len, jnp.int32)
+    meta = jnp.stack([jnp.asarray(base, jnp.int32), total])
+    block_table = jnp.asarray(block_table, jnp.int32)
+    # [C, H, D] -> [Hkv, G, C, D]: one kv head (and its query group) per
+    # outer grid step keeps the f32 accumulator at G*C*D, not H*C*D
+    qg = q.reshape(c, hkv, groups, d).transpose(1, 2, 0, 3)
+
+    def page_index(hh, p, meta_ref, bt_ref):
+        # pages past the valid total revisit the last valid block (an
+        # unchanged index skips the DMA); total 0 degenerates to the
+        # table's first entry (the null block)
+        last = jnp.maximum((meta_ref[1] + block - 1) // block - 1, 0)
+        return (bt_ref[jnp.minimum(p, last)], 0, hh, 0)
+
+    out = pl.pallas_call(
+        functools.partial(_prefill_kernel, sm_scale=sm_scale, block=block),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(hkv, npages),
+            in_specs=[
+                pl.BlockSpec((None, groups, c, d),
+                             lambda hh, p, *_: (hh, 0, 0, 0)),
+                pl.BlockSpec((None, block, None, d), page_index),
+                pl.BlockSpec((None, block, None, d), page_index),
+            ],
+            out_specs=pl.BlockSpec((None, groups, c, d),
+                                   lambda hh, p, *_: (hh, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((groups, c), jnp.float32),
+                pltpu.VMEM((groups, c), jnp.float32),
+                pltpu.VMEM((groups, c, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((hkv, groups, c, d), q.dtype),
+        compiler_params=compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(meta, block_table, qg, pool_k, pool_v)
+    return out.transpose(2, 0, 1, 3).reshape(c, h, d)
+
+
+def paged_prefill_reference(q, pool_k, pool_v, base, chunk_len,
+                            block_table):
+    """Readable jnp reference for the chunked-prefill kernel (tests pin
+    against this): gather the table's pages into a contiguous cache and
+    run causally-masked dense attention for the chunk's rows.  Padding
+    queries (index >= chunk_len) are returned as zeros."""
+    c, h, d = q.shape
+    block = pool_k.shape[1]
+    hkv = pool_k.shape[2]
+    npages = block_table.shape[0]
+    g = h // hkv
+    k = pool_k[block_table].reshape(npages * block, hkv, d)
+    v = pool_v[block_table].reshape(npages * block, hkv, d)
+    if g > 1:
+        k = jnp.repeat(k, g, axis=1)
+        v = jnp.repeat(v, g, axis=1)
+    s = jnp.einsum("chd,shd->chs", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / math.sqrt(d)
+    pos = jnp.arange(npages * block)[None, None, :]
+    qpos = base + jnp.arange(c)[:, None, None]
+    s = jnp.where((pos <= qpos) & (pos < base + chunk_len), s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("chs,shd->chd", p, v.astype(jnp.float32))
+    valid = (jnp.arange(c) < chunk_len)[:, None, None]
+    return jnp.where(valid, out, 0.0).astype(q.dtype)
+
+
 def paged_attention_reference(q, pool_k, pool_v, lengths, block_tables):
     """Readable jnp reference (tests pin the kernel against this): per
     slot, gather the table's pages into a contiguous cache and run

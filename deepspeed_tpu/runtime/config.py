@@ -166,6 +166,17 @@ class ZeroConfig(ConfigModel):
                 device=OffloadDeviceEnum.cpu)
         if not 0 <= self.stage <= 3:
             raise ValueError(f"zero_optimization.stage must be 0..3, got {self.stage}")
+        # wire-codec bit widths fail at PARSE time on every engine path
+        # (offload_bench's tier-1 path consumes offload_wire_bits without
+        # ever building an InfinityStepper, whose own checks these mirror)
+        if self.offload_param_bits not in (0, 4, 8):
+            raise ValueError(
+                f"zero_optimization.offload_param_bits must be 0, 4 or 8; "
+                f"got {self.offload_param_bits}")
+        if self.offload_wire_bits not in (0, 1, 4, 8):
+            raise ValueError(
+                f"zero_optimization.offload_wire_bits must be 0, 1, 4 or "
+                f"8; got {self.offload_wire_bits}")
         return self
 
 

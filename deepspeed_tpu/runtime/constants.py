@@ -118,6 +118,13 @@ OBSERVABILITY_EXPORT_INTERVAL_DEFAULT = 0       # steps; 0 = flush-only
 SERVING_KV_BLOCK_SIZE_DEFAULT = 16      # tokens per paged KV block
 SERVING_NUM_KV_BLOCKS_DEFAULT = 512     # pool blocks (block 0 reserved)
 SERVING_MAX_BATCH_SLOTS_DEFAULT = 8     # compiled decode-batch width
+# chunked prefill (Sarathi-Serve): prompt tokens processed per scheduler
+# iteration alongside the live decode slots — also the compiled chunk
+# width of the single mixed-batch program
+SERVING_PREFILL_CHUNK_TOKENS_DEFAULT = 256
+# content-addressed prefix caching over the paged pool (RadixAttention-
+# style block reuse): hit full blocks skip prefill
+SERVING_PREFIX_CACHE_DEFAULT = True
 
 ROUTE_TRAIN = "train"
 ROUTE_EVAL = "eval"

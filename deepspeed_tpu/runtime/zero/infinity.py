@@ -68,7 +68,7 @@ import math
 import os
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -254,6 +254,27 @@ class InfinityStepper:
         # collect-mode gradient accumulator, allocated lazily (fp32 [L, n])
         self._grad_accum: Optional[np.ndarray] = None
 
+        # H2D quantized-upload encode offload: the numpy quantize pass
+        # (encode_params_host) used to run inline in _ensure_layer ON the
+        # streaming thread, stalling the H2D lane (and every program
+        # dispatch behind it) for the duration of each layer's encode.
+        # Now: (a) encoded payloads are CACHED while a layer's masters
+        # are unchanged (the whole backward walk and any eval re-upload
+        # re-use the forward's encode — the sweep invalidates per
+        # layer), and (b) upcoming layers are encoded AHEAD on the
+        # layer-pinned worker pool so the stream thread uploads a ready
+        # payload. Both are gated to DRAM param stores: an NVMe store's
+        # pinned ring must not be acquired from a worker while the
+        # stream thread blocks on that worker's result (ring reclaim is
+        # stream-thread-gated — classic lock-order deadlock), and a
+        # full-model encode cache in DRAM would defeat NVMe offload.
+        self._enc_lock = threading.Lock()
+        self._enc_cache: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        self._enc_version = [0] * self.L
+        self._enc_futures: Dict[int, Future] = {}
+        self._enc_async = bool(self.param_bits) and \
+            op.device.value == "cpu"
+
         # -- init ----------------------------------------------------------
         self._init_state(rng)
 
@@ -434,6 +455,7 @@ class InfinityStepper:
         buf[:self.n_local * 2].view(np.uint16)[:] = (
             loc.astype(ml_dtypes.bfloat16).view(np.uint16))
         self.param_store.release(i, dirty=True)
+        self._invalidate_encoded(i)
 
     def _host_init_stds(self) -> List[float]:
         """Per-leaf init stddev matching model init (models/transformer.py
@@ -565,25 +587,93 @@ class InfinityStepper:
                 break
             del self._dev[victim]
         self._sweep_uploads()
-        buf = self.param_store.acquire(i)
-        host = buf[:self.n_local * 2].view(ml_dtypes.bfloat16)
         if self.param_bits:
-            # quantized upload: encode from the pinned slot synchronously,
-            # then the async DMA reads the ENCODED arrays — the slot pin
-            # can drop immediately (refs keep the payload alive instead)
-            payload, scales = wire_codec.encode_params_host(
-                host, self.param_bits)
-            self.param_store.release(i, dirty=False)
+            # quantized upload: the encoded payload comes from the cache,
+            # an ahead-of-need worker encode, or (NVMe store / cold
+            # start) an inline pass; the async DMA reads the ENCODED
+            # arrays — no slot pin outlives this call (refs keep the
+            # payload alive instead)
+            payload, scales = self._encoded_params(i)
             pay_total = {8: self.n_pad, 4: self.n_pad // 2}[self.param_bits]
             arrs = (self._put_vec(payload, pay_total),
                     self._put_vec(scales, self.n_pad // wire_codec.CHUNK))
             self._pending_uploads.append((None, arrs, (payload, scales)))
         else:
+            buf = self.param_store.acquire(i)
+            host = buf[:self.n_local * 2].view(ml_dtypes.bfloat16)
             arrs = (self._put_flat(host),)
             # pin held until transfer done
             self._pending_uploads.append((i, arrs, ()))
         self._dev[i] = arrs
         return arrs
+
+    # -- H2D encode cache / worker offload (param_bits only) ------------
+    def _invalidate_encoded(self, i: int) -> None:
+        """Layer i's masters changed (host Adam sweep, checkpoint load,
+        init): any cached or in-flight encoded payload is stale."""
+        if not self.param_bits:
+            return
+        with self._enc_lock:
+            self._enc_version[i] += 1
+            self._enc_cache.pop(i, None)
+            self._enc_futures.pop(i, None)
+
+    def _encode_slot(self, i: int, version: int):
+        """Worker-pool task: pinned slot -> (payload, scales) encode.
+        Runs on layer i's OWN pinned worker, so it serializes after any
+        queued sweep of the same layer (whose slot write would have
+        bumped ``version`` and made this result dead on arrival)."""
+        buf = self.param_store.acquire(i)
+        try:
+            host = buf[:self.n_local * 2].view(ml_dtypes.bfloat16)
+            enc = wire_codec.encode_params_host(host, self.param_bits)
+        finally:
+            self.param_store.release(i, dirty=False)
+        with self._enc_lock:
+            if self._enc_version[i] == version:
+                self._enc_cache[i] = enc
+        return version, enc
+
+    def _prefetch_encode(self, i: int) -> None:
+        """Queue layer i's quantize pass ahead of need so the streaming
+        thread uploads a ready payload instead of stalling the H2D lane
+        on the numpy encode (the forward walk prefetches i+2 while
+        uploading i+1 and computing i; the backward mirrors it)."""
+        if not self._enc_async or not 0 <= i < self.L or i in self._dev:
+            return
+        with self._enc_lock:
+            if i in self._enc_cache or i in self._enc_futures:
+                return
+            fut = self._submit(i, self._encode_slot, self._enc_version[i])
+            self._enc_futures[i] = fut
+
+    def _encoded_params(self, i: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Encoded (payload, scales) for layer i: unchanged-master cache
+        hit -> in-flight worker prefetch -> inline encode."""
+        if self._enc_async:
+            with self._enc_lock:
+                enc = self._enc_cache.get(i)
+                fut = self._enc_futures.pop(i, None)
+            if enc is not None:
+                return enc
+            if fut is not None:
+                version, enc = fut.result()
+                with self._enc_lock:
+                    if self._enc_version[i] == version:
+                        return enc
+        with self._enc_lock:
+            v0 = self._enc_version[i]
+        buf = self.param_store.acquire(i)
+        try:
+            host = buf[:self.n_local * 2].view(ml_dtypes.bfloat16)
+            enc = wire_codec.encode_params_host(host, self.param_bits)
+        finally:
+            self.param_store.release(i, dirty=False)
+        if self._enc_async:
+            with self._enc_lock:
+                if self._enc_version[i] == v0:
+                    self._enc_cache[i] = enc
+        return enc
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -791,9 +881,11 @@ class InfinityStepper:
         acts: List[Any] = [None] * L if stash else None
         aux = jnp.zeros((), jnp.float32)
         self._ensure_layer(0, {0})
+        self._prefetch_encode(1)
         for i in range(L):
             if i + 1 < L:
                 self._ensure_layer(i + 1, {i, i + 1})
+            self._prefetch_encode(i + 2)
             if stash:
                 acts[i] = x
             x, la = progs["block_fwd"](*self._dev[i], x)
@@ -839,6 +931,7 @@ class InfinityStepper:
         for i in reversed(range(self.L)):
             if i - 1 >= 0:
                 self._ensure_layer(i - 1, {i, i - 1})
+            self._prefetch_encode(i - 2)
             dflat, dy, sq = progs["block_vjp"](*self._dev[i], acts[i], dy)
             acts[i] = None
             if self.wire_bits:
@@ -887,6 +980,7 @@ class InfinityStepper:
             self.opt.step_slot(i, g, lr=lr,
                                grad_scale=grad_scale, out_bf16=out16)
             self.param_store.release(i, dirty=True)
+            self._invalidate_encoded(i)
 
     def _submit(self, i: int, fn, *args):
         """Dispatch a layer task to its pinned worker (i % N) — preserves
@@ -922,6 +1016,7 @@ class InfinityStepper:
             self.opt.step_slot(i, self._grad_accum[i], lr=lr,
                                grad_scale=grad_scale, out_bf16=out16)
             self.param_store.release(i, dirty=True)
+            self._invalidate_encoded(i)
             self._grad_accum[i] = 0.0
 
     def _finish_layer(self, i: int, dflat, lr: float,
@@ -1256,6 +1351,7 @@ class InfinityStepper:
                 buf[:self.n_local * 2].view(np.uint16)[:] = (
                     p.astype(ml_dtypes.bfloat16).view(np.uint16))
                 self.param_store.release(i, dirty=True)
+                self._invalidate_encoded(i)
         with _load_npz_retry(os.path.join(path, "resident.npz"),
                              self._io_policy) as z:
             n = meta["n_res_leaves"]
@@ -1292,6 +1388,7 @@ class InfinityStepper:
             buf[:self.n_local * 2].view(np.uint16)[:] = (
                 p.astype(ml_dtypes.bfloat16).view(np.uint16))
             self.param_store.release(i, dirty=True)
+            self._invalidate_encoded(i)
         self._load_resident_state(sd["resident"], sd["res_step_count"])
         self.param_store.flush()
         self.opt.flush()

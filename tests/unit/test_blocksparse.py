@@ -63,6 +63,7 @@ class TestKernel:
         ref = dense_ref(q, k, v, block_mask(layout))
         np.testing.assert_allclose(np.asarray(o), np.asarray(ref), atol=6e-3)
 
+    @pytest.mark.slow
     def test_grads_match_masked_dense(self):
         q, k, v = qkv(2)
         layout = np.zeros((H, NB, NB), np.int64)
@@ -141,6 +142,7 @@ class TestConfigsRun:
 
 
 class TestModelIntegration:
+    @pytest.mark.slow
     def test_attn_impl_blocksparse_trains(self):
         import deepspeed_tpu as ds
         from deepspeed_tpu.models import TransformerLM, gpt2_config
@@ -172,6 +174,7 @@ class TestModelIntegration:
 
 
 class TestSparseDecode:
+    @pytest.mark.slow
     def test_cached_decode_matches_sparse_forward(self):
         """Greedy decode through the KV cache must agree with full-forward
         argmax where the forward runs the blocksparse kernel — i.e. the

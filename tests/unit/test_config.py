@@ -89,6 +89,26 @@ def test_unknown_zero_key_rejected():
         ZeroConfig(stage=1, no_such_option=True)
 
 
+def test_zero_wire_bits_validated_at_parse_time():
+    """offload_param_bits / offload_wire_bits fail in the ZeroConfig
+    validator on EVERY engine path (not just inside InfinityStepper —
+    the tier-1 offload path consumes the wire bits without ever
+    building a stepper)."""
+    with pytest.raises(ValueError, match="offload_param_bits"):
+        ZeroConfig(stage=3, offload_param_bits=6)
+    with pytest.raises(ValueError, match="offload_wire_bits"):
+        ZeroConfig(stage=3, offload_wire_bits=2)
+    with pytest.raises(ValueError, match="offload_wire_bits"):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "zero_optimization": {"stage": 0,
+                                               "offload_wire_bits": 3}},
+                        world_size=1)
+    for pb in (0, 4, 8):
+        assert ZeroConfig(stage=3, offload_param_bits=pb).offload_param_bits == pb
+    for wb in (0, 1, 4, 8):
+        assert ZeroConfig(stage=3, offload_wire_bits=wb).offload_wire_bits == wb
+
+
 def test_mesh_block():
     cfg = DeepSpeedConfig({"train_batch_size": 8,
                            "mesh": {"data": 2, "model": 4}}, world_size=2)

@@ -59,6 +59,7 @@ class TestBackward:
     @pytest.mark.parametrize("t,block", [(128, (1024, 1024)),   # fused
                                          (256, (128, 128)),     # two-pass
                                          (512, (128, 256))])
+    @pytest.mark.slow
     def test_grads_match_xla(self, t, block):
         q, k, v = make_qkv(2, t, 4, 32, seed=1)
         fa = lambda q, k, v: flash_attention_bthd(  # noqa: E731
@@ -69,6 +70,7 @@ class TestBackward:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-4)
 
+    @pytest.mark.slow
     def test_fused_and_two_pass_agree(self):
         """The single-block fused backward must equal the two-pass scheme
         on the same inputs."""
@@ -83,6 +85,7 @@ class TestBackward:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-5)
 
+    @pytest.mark.slow
     def test_noncausal(self):
         q, k, v = make_qkv(1, 128, 2, 32, seed=3)
 

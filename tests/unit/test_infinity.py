@@ -474,6 +474,33 @@ class TestInfinityEngine:
             assert abs(float(r1["grad_norm"]) - float(r2["grad_norm"])) \
                 < 5e-2 * max(1.0, float(r1["grad_norm"]))
 
+    def test_param_wire_encode_cache_and_invalidation(self):
+        """The H2D quantize pass (encode_params_host) no longer runs on
+        the streaming thread per upload: payloads are cached while a
+        layer's masters are unchanged (repeated forwards re-use the
+        SAME encoded arrays), the host Adam sweep invalidates per
+        layer, and training still converges through the cached path."""
+        rng = jax.random.PRNGKey(0)
+        ids = ids_batch()
+        zero = dict(infinity_zero(), offload_param_bits=8)
+        e = DeepSpeedEngine(tiny_model(), config=engine_cfg(zero=zero),
+                            rng=rng, mesh=single_mesh())
+        st = e._infinity
+        assert st._enc_async          # DRAM param store: offload enabled
+        l0 = e.eval_loss({"input_ids": ids})
+        assert set(st._enc_cache) == set(range(st.L))
+        before = {i: id(st._enc_cache[i][0]) for i in st._enc_cache}
+        e.eval_loss({"input_ids": ids})   # unchanged masters: pure hits
+        assert {i: id(st._enc_cache[i][0])
+                for i in st._enc_cache} == before
+        versions = list(st._enc_version)
+        e.train_step({"input_ids": ids})  # sweep rewrites every layer
+        assert all(v2 > v1 for v1, v2 in zip(versions, st._enc_version))
+        for _ in range(5):
+            m = e.train_step({"input_ids": ids})
+            assert np.isfinite(m["loss"])
+        assert float(e.eval_loss({"input_ids": ids})) < float(l0) - 0.2
+
     def test_nvme_bitwise_matches_dram(self, tmp_path):
         rng = jax.random.PRNGKey(0)
         ids = ids_batch()

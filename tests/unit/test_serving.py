@@ -136,14 +136,90 @@ class TestPagedDecodeKernel:
 
 
 # ---------------------------------------------------------------------------
+# chunked-prefill kernel parity
+# ---------------------------------------------------------------------------
+def make_prefill_case(base, chunk_len, c, bs, nb, h=4, hkv=4, d=32,
+                      seed=0, garbage=None):
+    """Random pool + one slot's shuffled block table covering
+    ``base + chunk_len`` rows; rows past the total can be poisoned with
+    ``garbage`` to prove the masks."""
+    rng = np.random.default_rng(seed)
+    q = rng.standard_normal((c, h, d)).astype(np.float32)
+    pk = rng.standard_normal((nb, bs, hkv, d)).astype(np.float32)
+    pv = rng.standard_normal((nb, bs, hkv, d)).astype(np.float32)
+    total = base + chunk_len
+    npages = max(1, -(-total // bs))
+    avail = list(rng.permutation(np.arange(1, nb)))
+    bt = np.zeros((npages,), np.int32)
+    for p in range(npages):
+        bt[p] = avail.pop()
+    if garbage is not None and total % bs:
+        pk[bt[npages - 1], total % bs:] = garbage
+        pv[bt[npages - 1], total % bs:] = garbage
+    return (jnp.asarray(q), jnp.asarray(pk), jnp.asarray(pv),
+            jnp.asarray(base, jnp.int32), jnp.asarray(chunk_len, jnp.int32),
+            jnp.asarray(bt))
+
+
+class TestPagedPrefillKernel:
+    @pytest.mark.parametrize("base,chunk_len,c",
+                             [(0, 7, 8), (5, 8, 8), (16, 3, 8),
+                              (0, 16, 16), (13, 11, 16)])
+    def test_parity_ragged_chunks(self, base, chunk_len, c):
+        """Causal chunk attention through the block table matches the
+        gathered dense reference for chunks starting anywhere in the
+        sequence (base = prior context already in the pool)."""
+        q, pk, pv, b, cl, bt = make_prefill_case(base, chunk_len, c,
+                                                 bs=4, nb=24)
+        from deepspeed_tpu.ops.transformer.paged_decode_attention import (
+            paged_prefill_attention, paged_prefill_reference)
+        out = paged_prefill_attention(q, pk, pv, b, cl, bt, interpret=True)
+        ref = paged_prefill_reference(q, pk, pv, b, cl, bt)
+        np.testing.assert_allclose(np.asarray(out)[:chunk_len],
+                                   np.asarray(ref)[:chunk_len], atol=2e-5)
+
+    def test_gqa_parity(self):
+        q, pk, pv, b, cl, bt = make_prefill_case(9, 6, 8, bs=4, nb=16,
+                                                 h=8, hkv=2)
+        from deepspeed_tpu.ops.transformer.paged_decode_attention import (
+            paged_prefill_attention, paged_prefill_reference)
+        out = paged_prefill_attention(q, pk, pv, b, cl, bt, interpret=True)
+        ref = paged_prefill_reference(q, pk, pv, b, cl, bt)
+        np.testing.assert_allclose(np.asarray(out)[:6],
+                                   np.asarray(ref)[:6], atol=2e-5)
+
+    def test_stale_tail_garbage_masked(self):
+        """Rows past base+chunk_len in the last page are recycled-pool
+        garbage — they must be masked, not multiplied away."""
+        q, pk, pv, b, cl, bt = make_prefill_case(5, 6, 8, bs=8, nb=8,
+                                                 garbage=1e4)
+        from deepspeed_tpu.ops.transformer.paged_decode_attention import (
+            paged_prefill_attention, paged_prefill_reference)
+        out = paged_prefill_attention(q, pk, pv, b, cl, bt, interpret=True)
+        ref = paged_prefill_reference(q, pk, pv, b, cl, bt)
+        np.testing.assert_allclose(np.asarray(out)[:6],
+                                   np.asarray(ref)[:6], atol=2e-5)
+
+    def test_zero_length_chunk_returns_finite(self):
+        """The idle prefill lane of the mixed program: length 0 must
+        produce finite (zero) rows, not 0/0."""
+        q, pk, pv, b, cl, bt = make_prefill_case(0, 0, 8, bs=4, nb=8)
+        from deepspeed_tpu.ops.transformer.paged_decode_attention import (
+            paged_prefill_attention)
+        out = np.asarray(
+            paged_prefill_attention(q, pk, pv, b, cl, bt, interpret=True))
+        assert np.isfinite(out).all() and (out == 0).all()
+
+
+# ---------------------------------------------------------------------------
 # block allocator
 # ---------------------------------------------------------------------------
 class TestBlockAllocator:
     def test_alloc_free_roundtrip(self):
         a = PagedBlockAllocator(num_blocks=8, block_size=4)
         assert a.usable_blocks == 7
-        t = a.allocate("s0", tokens=9)        # 3 blocks
-        assert len(t) == 3 and 0 not in t
+        t, cached = a.allocate("s0", tokens=9)        # 3 blocks
+        assert len(t) == 3 and 0 not in t and cached == 0
         assert a.num_used == 3
         a.free("s0")
         assert a.num_free == 7
@@ -184,26 +260,161 @@ class TestBlockAllocator:
         a.free("b"), a.free("b2")
         a.assert_consistent()
 
+    # -- prefix cache ------------------------------------------------------
+    def test_prefix_hit_shares_committed_blocks(self):
+        """Two requests over the same prompt: after the first commits
+        its full blocks, the second's allocate resolves them by content
+        hash and reports the cached rows — while the first still RUNS
+        (refcount sharing, not LRU revival)."""
+        a = PagedBlockAllocator(num_blocks=16, block_size=4)
+        ids = list(range(10))                  # 2 full blocks + tail
+        t1, c1 = a.allocate("s1", 11, token_ids=ids)
+        assert c1 == 0                         # nothing committed yet
+        a.commit_cached("s1", ids, 10)
+        t2, c2 = a.allocate("s2", 11, token_ids=ids)
+        assert c2 == 8                         # both full blocks hit
+        assert t2[:2] == t1[:2] and t2[2] != t1[2]
+        assert a.hit_tokens_total == 8
+        a.assert_consistent()
+        a.free("s1")
+        a.assert_consistent()                  # shared blocks still held
+        a.free("s2")
+        a.assert_consistent()
+
+    def test_freed_blocks_park_in_lru_and_serve_hits(self):
+        """finish/preempt path: committed blocks of a FREED sequence
+        stay hittable (refcount 0, parked in the LRU) until capacity
+        pressure evicts them — the resubmission skips its prefix."""
+        a = PagedBlockAllocator(num_blocks=16, block_size=4)
+        ids = list(range(12))                  # 3 full blocks
+        a.allocate("s1", 13, token_ids=ids)
+        a.commit_cached("s1", ids, 12)
+        a.free("s1")
+        assert a.num_cached == 3 and a.num_used == 0
+        # at least one token must stay computable: 2 of 3 full blocks hit
+        t, cached = a.allocate("s2", 13, token_ids=ids)
+        assert cached == 8 and a.num_cached == 1
+        a.free("s2")
+        a.assert_consistent()
+
+    def test_lru_eviction_under_pressure(self):
+        """Cached blocks are capacity first: when the raw free list runs
+        dry, allocation evicts the LEAST-recently-used cached block and
+        its registration dies with it."""
+        a = PagedBlockAllocator(num_blocks=6, block_size=4)   # 5 usable
+        old = [1, 2, 3, 4]
+        new = [5, 6, 7, 8]
+        a.allocate("old", 5, token_ids=old)
+        a.commit_cached("old", old, 4)
+        a.free("old")                          # 1 block cached, 1 free...
+        a.allocate("new", 5, token_ids=new)
+        a.commit_cached("new", new, 4)
+        a.free("new")
+        # each seq held 2 blocks (5 tokens) but only its full one is
+        # committed; the uncommitted tails went straight back free
+        assert a.num_cached == 2
+        a.allocate("big", 17, token_ids=None)  # needs 5 of 5 usable
+        assert a.evictions_total >= 2          # both cached blocks evicted
+        a.free("big")
+        _, cached = a.allocate("re", 5, token_ids=old)
+        assert cached == 0                     # the old prefix died
+        a.free("re")
+        a.assert_consistent()
+
+    def test_commit_idempotent_and_first_owner_wins(self):
+        a = PagedBlockAllocator(num_blocks=16, block_size=4)
+        ids = list(range(8))
+        a.allocate("s1", 9, token_ids=ids)
+        assert a.commit_cached("s1", ids, 8) == 2
+        assert a.commit_cached("s1", ids, 8) == 0    # idempotent
+        # a second sequence computing the same content does not steal
+        # the registration
+        a.allocate("s2", 9, token_ids=None)
+        assert a.commit_cached("s2", ids, 8) == 0
+        a.free("s1"), a.free("s2")
+        a.assert_consistent()
+
+    def test_duplicate_content_is_cache_resident(self):
+        # first-owner-wins means a later sequence's private copies of
+        # the same content register nothing — but its CONTENT is in the
+        # index, so eviction is just as cheap (re-admission hits the
+        # owner's blocks); residency must be by chain membership, not
+        # per-block registration
+        a = PagedBlockAllocator(num_blocks=16, block_size=4)
+        ids = list(range(8))
+        a.allocate("s1", 9, token_ids=ids)
+        a.commit_cached("s1", ids, 8)
+        a.allocate("s2", 9, token_ids=None)    # own copies, no hits
+        assert a.commit_cached("s2", ids, 8) == 0
+        assert a.is_cache_resident("s2", 8)
+        a.free("s1"), a.free("s2")
+        a.assert_consistent()
+
+    def test_probe_fresh_need_discounts_live_hits(self):
+        # admission feasibility: blocks shared from LIVE sequences cost
+        # no free capacity, parked/uncached blocks cost one each — so
+        # concurrent shared-prefix requests admit even when the free
+        # pool only covers their tails
+        a = PagedBlockAllocator(num_blocks=9, block_size=4)   # 8 usable
+        ids = list(range(20))                  # 5 full blocks
+        a.allocate("s1", 21, token_ids=ids)    # holds 6 of 8 blocks
+        a.commit_cached("s1", ids, 20)
+        assert a.num_free == 2
+        # full demand for the same prefix is 6 blocks, but 4 are live
+        # hits (the last full block is never served from cache): two
+        # fresh blocks suffice
+        assert a.probe_fresh_need(21, ids) == 2
+        assert a.can_allocate(a.probe_fresh_need(21, ids))
+        t2, cached = a.allocate("s2", 21, token_ids=ids)
+        assert cached == 16
+        a.free("s1"), a.free("s2")
+        a.assert_consistent()
+
+    def test_prefix_cache_disabled(self):
+        a = PagedBlockAllocator(16, 4, enable_prefix_cache=False)
+        ids = list(range(8))
+        a.allocate("s1", 9, token_ids=ids)
+        assert a.commit_cached("s1", ids, 8) == 0
+        a.free("s1")
+        assert a.num_cached == 0
+        _, cached = a.allocate("s2", 9, token_ids=ids)
+        assert cached == 0
+        a.free("s2")
+        a.assert_consistent()
+
     def test_property_random_cycles_never_leak(self):
-        """Fuzz admit/grow/fork/free against the invariant checker —
-        the allocator must stay exactly partitioned between the free
-        list and live tables through arbitrary scheduling histories."""
+        """Fuzz admit (with and without prefix hits)/grow/fork/free/
+        commit against the invariant checker — refcounts, the hash
+        index, the cached LRU and the free list must stay exactly
+        partitioned through arbitrary scheduling histories, including
+        LRU evictions under pressure."""
         rng = np.random.default_rng(0)
         a = PagedBlockAllocator(num_blocks=24, block_size=4)
-        live, counter = {}, 0
+        # a small universe of shared "prompts" so hits actually happen
+        prompts = [list(rng.integers(0, 50, n)) for n in (8, 12, 20, 9)]
+        live, counter, hits = {}, 0, 0
         for step in range(600):
-            op = rng.choice(["alloc", "grow", "free", "fork"])
+            op = rng.choice(["alloc", "alloc_cached", "grow", "free",
+                             "fork", "commit"])
             try:
                 if op == "alloc":
                     sid = f"s{counter}"
                     counter += 1
                     tokens = int(rng.integers(1, 30))
                     a.allocate(sid, tokens)
-                    live[sid] = tokens
+                    live[sid] = (tokens, None)
+                elif op == "alloc_cached":
+                    sid = f"s{counter}"
+                    counter += 1
+                    ids = prompts[int(rng.integers(len(prompts)))]
+                    _, c = a.allocate(sid, len(ids) + 1, token_ids=ids)
+                    hits += c
+                    live[sid] = (len(ids) + 1, list(ids))
                 elif op == "grow" and live:
                     sid = rng.choice(sorted(live))
                     a.append_block(sid)
-                    live[sid] += a.block_size
+                    t, ids = live[sid]
+                    live[sid] = (t + a.block_size, ids)
                 elif op == "free" and live:
                     sid = rng.choice(sorted(live))
                     a.free(sid)
@@ -212,11 +423,18 @@ class TestBlockAllocator:
                     sid = rng.choice(sorted(live))
                     dst = f"s{counter}"
                     counter += 1
-                    a.fork(sid, dst, live[sid])
+                    a.fork(sid, dst, live[sid][0])
                     live[dst] = live[sid]
+                elif op == "commit" and live:
+                    sid = rng.choice(sorted(live))
+                    t, ids = live[sid]
+                    if ids is not None:
+                        a.commit_cached(sid, ids, min(t, len(ids)))
             except BlockPoolError:
                 pass                           # exhaustion is legal; leaks are not
             a.assert_consistent()
+        assert hits > 0 and a.evictions_total > 0, \
+            "fuzz never exercised the cache: tune the universe"
         for sid in list(live):
             a.free(sid)
         a.assert_consistent()
@@ -279,6 +497,33 @@ class TestScheduler:
         s.finish(s1)
         a.assert_consistent()
 
+    def test_preemption_stays_lifo_with_prefix_cache_off(self):
+        # with the cache disabled nothing is ever hash-registered, so
+        # the residency-preferring walk must be skipped entirely — it
+        # would otherwise prefer whichever victim holds zero FULL
+        # blocks (vacuously "resident"), repeatedly preempting an older
+        # short-prompt request instead of the LIFO victim
+        alloc = PagedBlockAllocator(6, 4, enable_prefix_cache=False)
+        s = ContinuousBatchingScheduler(2, alloc, 8)
+        # r1 stays inside its first block forever (vacuously "resident":
+        # zero FULL blocks); r2 grows until the pool runs dry
+        r1 = s.submit(Request(prompt=[1, 2], max_new_tokens=1))
+        r2 = s.submit(Request(prompt=[1, 2, 3, 4, 5], max_new_tokens=8))
+        s.schedule_admissions()
+        for r in (r1, r2):
+            r.cached_tokens = len(r.prompt)
+            r.output.append(7)
+        preempted = []
+        for _ in range(12):
+            r2.cached_tokens += 1
+            preempted = s.ensure_decode_capacity()
+            if preempted:
+                break
+        assert preempted == [r2], \
+            "latest-admitted must be the victim when the cache is off"
+        assert r1.state is RequestState.RUNNING
+        alloc.assert_consistent()
+
     def test_finish_frees_blocks(self):
         s, a = mk_sched()
         r = s.submit(Request(prompt=[1, 2], max_new_tokens=2))
@@ -302,11 +547,14 @@ def serving_engine(serving=None, model_cfg=None, **cfg):
         TransformerLM(model_cfg or tiny_cfg()),
         # kernel injection off: the sequential-generate BASELINE must
         # run the xla decode path on every backend; the serving side
-        # under test always uses the paged Pallas kernel regardless
+        # under test always uses the paged Pallas kernels regardless.
+        # prefill_chunk_tokens 16 keeps the interpret-mode chunk lane
+        # cheap AND forces real multi-chunk prefills for longer prompts
         config={"dtype": "float32", "max_out_tokens": 64,
                 "temperature": 0.0, "replace_with_kernel_inject": False,
                 "serving": {"enabled": True, "kv_block_size": 8,
                             "num_kv_blocks": 48, "max_batch_slots": 8,
+                            "prefill_chunk_tokens": 16,
                             **(serving or {})},
                 **cfg})
     return eng, eng.serving_engine()
@@ -472,6 +720,112 @@ class TestServingEngine:
         assert reg.gauge("dstpu_serving_kv_blocks_in_use").value == 0
         assert reg.histogram(
             "dstpu_serving_inter_token_seconds").count > 0
+
+    def test_multi_chunk_prefill_matches_generate(self):
+        """A prompt longer than the chunk budget prefills over several
+        iterations (decode running alongside) and still reproduces the
+        sequential generate() stream exactly."""
+        eng, srv = serving_engine(serving={"prefill_chunk_tokens": 4})
+        rs = np.random.RandomState(21)
+        prompts = [rs.randint(0, 64, (n,)).tolist() for n in (15, 6)]
+        reqs = [srv.submit(p, max_new_tokens=6) for p in prompts]
+        srv.run(max_steps=200)
+        for p, r in zip(prompts, reqs):
+            want = np.asarray(
+                eng.generate(np.asarray(p, np.int32)[None],
+                             max_new_tokens=6, temperature=0.0))[0]
+            np.testing.assert_array_equal(np.asarray(r.output), want,
+                                          err_msg=f"prompt {p}")
+        assert srv.decode_builds == 1
+
+    def test_warm_prefix_hits_and_streams_match(self):
+        """The RadixAttention claim end-to-end: a second request over a
+        shared prompt hits the committed blocks (skipping most of its
+        prefill) and its stream is STILL token-identical to
+        generate()."""
+        eng, srv = serving_engine()
+        rs = np.random.RandomState(23)
+        shared = rs.randint(0, 64, (24,)).tolist()   # 3 full blocks
+        r1 = srv.submit(shared, max_new_tokens=5)
+        srv.run(max_steps=100)
+        assert r1.cache_hit_tokens == 0              # cold
+        r2 = srv.submit(shared, max_new_tokens=5)
+        srv.run(max_steps=100)
+        # the cap leaves >= 1 token to compute; everything else hits
+        assert r2.cache_hit_tokens == 16
+        want = np.asarray(eng.generate(
+            np.asarray(shared, np.int32)[None], max_new_tokens=5,
+            temperature=0.0))[0]
+        np.testing.assert_array_equal(np.asarray(r1.output), want)
+        np.testing.assert_array_equal(np.asarray(r2.output), want)
+        from deepspeed_tpu.observability import get_registry
+        assert get_registry().counter(
+            "dstpu_serving_prefix_cache_hit_tokens_total").value > 0
+
+    def test_preempt_resume_recomputes_only_uncached_tail(self):
+        """A preempted request's committed blocks park in the cached
+        LRU; its re-admission hits them, so the resume pays only the
+        uncached tail — pinned via the per-request hit counter."""
+        cfg = gpt2_config("125m", num_layers=2, d_model=32, num_heads=4,
+                          vocab_size=64, max_seq_len=64,
+                          dtype=jnp.float32)
+        # sized so the full load (3 x 6 blocks) overflows the pool
+        # (preemption fires) but the victim's 2 committed prompt blocks
+        # survive in the LRU until its re-admission (12 + 2 = 14 usable)
+        eng, srv = serving_engine(
+            serving={"kv_block_size": 4, "num_kv_blocks": 15,
+                     "max_batch_slots": 3, "prefill_chunk_tokens": 16},
+            model_cfg=cfg, max_out_tokens=48)
+        rs = np.random.RandomState(31)
+        prompts = [rs.randint(0, 64, (n,)).tolist() for n in (8, 8, 8)]
+        reqs = [srv.submit(p, max_new_tokens=12) for p in prompts]
+        srv.run(max_steps=500)
+        assert srv.scheduler.preemption_count > 0
+        resumed = [r for r in reqs if r.preemptions > 0]
+        assert resumed and all(r.cache_hit_tokens >= 4 for r in resumed), \
+            [(r.preemptions, r.cache_hit_tokens) for r in reqs]
+        for p, r in zip(prompts, reqs):
+            want = np.asarray(
+                eng.generate(np.asarray(p, np.int32)[None],
+                             max_new_tokens=12, temperature=0.0))[0]
+            np.testing.assert_array_equal(np.asarray(r.output), want)
+        assert srv.decode_builds == 1
+        assert srv.allocator.num_used == 0
+
+    def test_staggered_preemption_acceptance(self):
+        """The extended acceptance pin: 8 staggered requests on an
+        undersized pool (forced preemption), prefix caching and chunked
+        prefill both on — every stream identical to sequential
+        generate(), ONE compiled program across wildly mixed prompt
+        lengths, pool leak-free."""
+        cfg = gpt2_config("125m", num_layers=2, d_model=32, num_heads=4,
+                          vocab_size=64, max_seq_len=64,
+                          dtype=jnp.float32)
+        eng, srv = serving_engine(
+            serving={"kv_block_size": 4, "num_kv_blocks": 14,
+                     "max_batch_slots": 4, "prefill_chunk_tokens": 8},
+            model_cfg=cfg, max_out_tokens=48)
+        rs = np.random.RandomState(17)
+        prompts = [rs.randint(0, 64, (n,)).tolist()
+                   for n in (5, 9, 12, 16, 3, 7, 14, 10)]
+        reqs = [srv.submit(p, max_new_tokens=8) for p in prompts[:3]]
+        srv.step()
+        reqs += [srv.submit(p, max_new_tokens=8) for p in prompts[3:6]]
+        srv.step()
+        srv.step()
+        reqs += [srv.submit(p, max_new_tokens=8) for p in prompts[6:]]
+        finished = srv.run(max_steps=1000)
+        assert len(finished) == 8
+        assert srv.scheduler.preemption_count > 0
+        for p, r in zip(prompts, reqs):
+            want = np.asarray(
+                eng.generate(np.asarray(p, np.int32)[None],
+                             max_new_tokens=8, temperature=0.0))[0]
+            np.testing.assert_array_equal(np.asarray(r.output), want,
+                                          err_msg=f"prompt {p}")
+        assert srv.decode_builds == 1
+        srv.allocator.assert_consistent()
+        assert srv.allocator.num_used == 0
 
     def test_unsupported_model_rejected_loudly(self):
         cfg = tiny_cfg(pos_embedding="alibi")
