@@ -113,6 +113,8 @@ def serving_decode_bench(size: str = "125m", slots: int = 8,
     dt = time.perf_counter() - t0
     toks = sum(len(r.output) for r in reqs)
     iter_ms = ((itl.sum - warm_sum) / max(itl.count - warm_n, 1)) * 1e3
+    n_req = len(prompts) + 1                 # incl. the warmup request
+    lc = srv.lifecycle_counts
     print(json.dumps({
         "metric": "decode_batched_tokens_per_sec",
         "value": round(toks / dt, 1), "unit": "tokens/s",
@@ -120,6 +122,14 @@ def serving_decode_bench(size: str = "125m", slots: int = 8,
         "prompt": prompt, "new": new,
         "decode_iter_mean_ms": round(iter_ms, 3),
         "preemptions": srv.scheduler.preemption_count,
+        # lifecycle rates (docs/serving.md "Failure handling &
+        # overload") — the acceptance instrument for SLO work: a bench
+        # run that sheds/expires/quarantines is overloaded or broken,
+        # and these make it visible next to the throughput number
+        "shed_rate": round(lc["shed"] / n_req, 3),
+        "timeout_rate": round(lc["timed_out"] / n_req, 3),
+        "quarantine_rate": round(lc["quarantined"] / n_req, 3),
+        "cancelled": lc["cancelled"], "failed": lc["failed"],
         "decode_builds": srv.decode_builds}), flush=True)
 
 
@@ -180,6 +190,12 @@ def prefix_cache_bench(size: str = "125m", slots: int = 8,
         "cold_round_hit_rate": round(cold_hits / prompt_tokens, 3),
         "shared_tokens": system, "requests": n_req,
         "evictions": srv.allocator.evictions_total,
+        "shed_rate": round(srv.lifecycle_counts["shed"] / (2 * n_req + 1),
+                           3),
+        "timeout_rate": round(
+            srv.lifecycle_counts["timed_out"] / (2 * n_req + 1), 3),
+        "quarantine_rate": round(
+            srv.lifecycle_counts["quarantined"] / (2 * n_req + 1), 3),
         "decode_builds": srv.decode_builds}), flush=True)
 
 

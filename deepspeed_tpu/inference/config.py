@@ -40,6 +40,24 @@ class ServingConfig(ConfigModel):
     # content-addressed prefix caching (RadixAttention-style): shared or
     # resubmitted prefixes reuse pool blocks instead of re-prefilling
     prefix_cache: bool = C.SERVING_PREFIX_CACHE_DEFAULT
+    # -- robustness / overload control (docs/serving.md "Failure
+    # handling & overload") --
+    # bounded backpressure: submit() beyond this many WAITING requests
+    # returns the request terminal with status SHED instead of queueing
+    # it (0 = unbounded)
+    max_queue_depth: int = C.SERVING_MAX_QUEUE_DEPTH_DEFAULT
+    # preemption-thrash guard: after this many preemptions a request is
+    # pinned (never a victim again); if the pool then cannot grow at
+    # all, the growing request fails loudly (0 = no cap)
+    max_preemptions: int = C.SERVING_MAX_PREEMPTIONS_DEFAULT
+    # no-progress watchdog: consecutive zero-progress iterations (while
+    # work remains) before step() raises ServingError with scheduler
+    # diagnostics (0 = disabled)
+    no_progress_steps: int = C.SERVING_NO_PROGRESS_STEPS_DEFAULT
+    # default request TTL in seconds, swept each step() for WAITING and
+    # RUNNING requests (terminal status TIMED_OUT); 0 = none;
+    # submit(deadline_s=...) overrides per request
+    default_deadline_s: float = C.SERVING_DEFAULT_DEADLINE_S_DEFAULT
 
     @model_validator(mode="after")
     def _validate(self):
@@ -59,6 +77,22 @@ class ServingConfig(ConfigModel):
             raise ValueError(
                 f"serving.prefill_chunk_tokens must be >= 1, got "
                 f"{self.prefill_chunk_tokens}")
+        if self.max_queue_depth < 0:
+            raise ValueError(
+                f"serving.max_queue_depth must be >= 0 (0 = unbounded), "
+                f"got {self.max_queue_depth}")
+        if self.max_preemptions < 0:
+            raise ValueError(
+                f"serving.max_preemptions must be >= 0 (0 = no cap), "
+                f"got {self.max_preemptions}")
+        if self.no_progress_steps < 0:
+            raise ValueError(
+                f"serving.no_progress_steps must be >= 0 (0 = disabled), "
+                f"got {self.no_progress_steps}")
+        if self.default_deadline_s < 0:
+            raise ValueError(
+                f"serving.default_deadline_s must be >= 0 (0 = none), "
+                f"got {self.default_deadline_s}")
         return self
 
 

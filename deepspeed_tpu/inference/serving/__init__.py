@@ -10,12 +10,13 @@ Three layers, composed by ``InferenceEngine.serving_engine()``:
     over ``ops/transformer/paged_decode_attention.py``, instrumented
     with the ``dstpu_serving_*`` observability metrics.
 """
+from ...runtime.resilience.errors import ServingError  # noqa: F401
 from .block_allocator import (BlockPoolError, NULL_BLOCK,  # noqa: F401
                               PagedBlockAllocator)
 from .engine import ServingEngine  # noqa: F401
 from .scheduler import (ContinuousBatchingScheduler, Request,  # noqa: F401
-                        RequestState)
+                        RequestState, RequestStatus)
 
 __all__ = ["BlockPoolError", "NULL_BLOCK", "PagedBlockAllocator",
            "ContinuousBatchingScheduler", "Request", "RequestState",
-           "ServingEngine"]
+           "RequestStatus", "ServingEngine", "ServingError"]

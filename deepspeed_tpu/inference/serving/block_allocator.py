@@ -39,15 +39,19 @@ import hashlib
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ...runtime.resilience.errors import ServingError
+from ...runtime.resilience.fault_injection import get_fault_injector
+
 NULL_BLOCK = 0
 
 #: chain root: the "hash" of the empty prefix
 ROOT_HASH = b""
 
 
-class BlockPoolError(RuntimeError):
+class BlockPoolError(ServingError):
     """Allocator invariant violation (double free, exhaustion, unknown
-    sequence) — scheduler bugs, never user input."""
+    sequence) — scheduler bugs, never user input.  Part of the
+    resilience layer's :class:`ServingError` branch."""
 
 
 def _chain_hash(prev: bytes, token_ids: Tuple[int, ...]) -> bytes:
@@ -188,6 +192,9 @@ class PagedBlockAllocator:
         """
         if seq_id in self._tables:
             raise BlockPoolError(f"sequence {seq_id!r} already has blocks")
+        # injection site BEFORE any state mutation: a fault here leaves
+        # the pool exactly as it was (the chaos suite asserts that)
+        get_fault_injector().check("serving.allocate")
         need = self.blocks_for_tokens(tokens)
         # feasibility discounts hits on LIVE blocks (pure refcount
         # sharing, no free capacity consumed) — without this a shared
@@ -266,6 +273,7 @@ class PagedBlockAllocator:
         table = self._tables.get(seq_id)
         if table is None:
             raise BlockPoolError(f"unknown sequence {seq_id!r}")
+        get_fault_injector().check("serving.append_block")
         if not self.can_allocate(1):
             raise BlockPoolError(
                 f"pool exhausted growing {seq_id!r} "
@@ -281,12 +289,20 @@ class PagedBlockAllocator:
             raise BlockPoolError(f"unknown sequence {seq_id!r}")
         return list(table)
 
-    def free(self, seq_id: str) -> None:
+    def free(self, seq_id: str, discard: bool = False) -> None:
         """Release a sequence's blocks (finish or preemption). Shared
         blocks (fork / prefix hits) only leave the tables when the last
         reference drops; registered blocks park in the cached LRU
         instead of the free list so the prefix they hold stays hittable
-        until capacity pressure evicts it."""
+        until capacity pressure evicts it.
+
+        ``discard=True`` is the quarantine path: the sequence's KV
+        content is SUSPECT (non-finite activations were detected), so
+        every block it touched is unregistered from the prefix-cache
+        index before release — refcount-0 blocks go straight to the raw
+        free list, never to the cached LRU, and a live shared block
+        (still refcounted by a sibling) keeps serving that sibling but
+        can never be hit again."""
         table = self._tables.pop(seq_id, None)
         if table is None:
             raise BlockPoolError(
@@ -296,6 +312,8 @@ class PagedBlockAllocator:
             if self._ref[b] <= 0:
                 raise BlockPoolError(
                     f"double free of block {b} (sequence {seq_id!r})")
+            if discard:
+                self._unregister(b)
             self._ref[b] -= 1
             if self._ref[b] == 0:
                 self._release_block(b)

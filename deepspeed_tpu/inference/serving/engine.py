@@ -27,6 +27,17 @@ Observability (PR-3 layer): queue-depth / batch-occupancy / blocks-in-
 use / cached-blocks gauges, TTFT + inter-token-latency histograms,
 token + preemption + prefix-cache hit/evict counters — all under
 ``dstpu_serving_*`` (docs/serving.md lists them).
+
+Robustness (docs/serving.md "Failure handling & overload"): terminal
+request statuses (OK / CANCELLED / TIMED_OUT / FAILED / SHED) with
+``cancel()`` + per-request deadlines swept each step; bounded submit
+backpressure (``max_queue_depth``) and a preemption-thrash pin-or-fail
+guard; per-slot finite-flag quarantine computed INSIDE the one compiled
+program (a poisoned request fails alone, its KV never reaches the
+prefix cache, the batch continues); a no-progress watchdog and
+fault-injection sites (``serving.allocate`` / ``serving.append_block``
+/ ``serving.admission`` / ``serving.dispatch``) that keep those failure
+paths tested in CI.
 """
 from __future__ import annotations
 
@@ -39,9 +50,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...observability import get_registry, trace_span
+from ...runtime.resilience.errors import (FatalIOError, ServingError,
+                                          TransientIOError)
+from ...runtime.resilience.fault_injection import get_fault_injector
 from ...utils.logging import logger
 from .block_allocator import PagedBlockAllocator
-from .scheduler import ContinuousBatchingScheduler, Request
+from .scheduler import (ContinuousBatchingScheduler, Request,
+                        RequestStatus)
 
 
 class ServingEngine:
@@ -85,7 +100,13 @@ class ServingEngine:
             cfg.num_kv_blocks, self.block_size,
             enable_prefix_cache=cfg.prefix_cache)
         self.scheduler = ContinuousBatchingScheduler(
-            self.num_slots, self.allocator, self.max_pages)
+            self.num_slots, self.allocator, self.max_pages,
+            max_queue_depth=cfg.max_queue_depth,
+            max_preemptions=cfg.max_preemptions)
+        self.no_progress_steps = cfg.no_progress_steps
+        self.default_deadline_s = cfg.default_deadline_s
+        #: consecutive zero-progress iterations (the serving watchdog)
+        self._no_progress = 0
         pools = model.init_paged_cache(cfg.num_kv_blocks, self.block_size,
                                        dtype=engine.dtype)
         self._pool_k, self._pool_v = pools["k"], pools["v"]
@@ -146,6 +167,30 @@ class ServingEngine:
         self._m_evictions = reg.counter(
             "dstpu_serving_prefix_cache_evictions_total",
             "cached blocks evicted from the LRU under capacity pressure")
+        # lifecycle terminals (docs/serving.md "Failure handling &
+        # overload"): every non-OK terminal increments exactly one of
+        # cancelled/timed_out/shed/failed; quarantines additionally
+        # increment the quarantined counter (they are FAILED requests
+        # whose KV was discarded)
+        self._m_cancelled = reg.counter(
+            "dstpu_serving_cancelled_total", "requests cancelled by caller")
+        self._m_timed_out = reg.counter(
+            "dstpu_serving_timed_out_total",
+            "requests expired by the per-request deadline sweep")
+        self._m_shed = reg.counter(
+            "dstpu_serving_shed_total",
+            "requests rejected at submit by max_queue_depth backpressure")
+        self._m_failed = reg.counter(
+            "dstpu_serving_failed_total",
+            "requests failed (quarantine, thrash pin-or-fail, fatal fault)")
+        self._m_quarantined = reg.counter(
+            "dstpu_serving_quarantined_total",
+            "requests quarantined on non-finite logits (KV discarded, "
+            "batch unaffected)")
+        #: plain-int mirror of the lifecycle counters for bench_all /
+        #: callers without the metrics registry
+        self.lifecycle_counts = {"cancelled": 0, "timed_out": 0,
+                                 "shed": 0, "failed": 0, "quarantined": 0}
         # counter deltas are polled off the (jax-free) allocator's
         # cumulative ints
         self._hits_polled = 0
@@ -155,18 +200,70 @@ class ServingEngine:
     # request intake
     # ------------------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
-               eos_token_id: Optional[int] = None) -> Request:
+               eos_token_id: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> Request:
+        """Queue a request.  ``deadline_s`` is a TTL from submit, swept
+        every ``step()`` whether the request is still WAITING or already
+        RUNNING (defaults to ``serving.default_deadline_s``; 0 = none).
+        Under overload (``serving.max_queue_depth`` waiting requests)
+        the request is returned TERMINAL with ``status ==
+        RequestStatus.SHED`` and an empty stream — check ``req.status``,
+        this is backpressure, not an exception."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         total = len(prompt) + max_new_tokens
         if total > self.engine.config.max_out_tokens:
             raise ValueError(
                 f"prompt+new = {total} exceeds max_out_tokens "
                 f"({self.engine.config.max_out_tokens})")
+        if deadline_s is not None and deadline_s < 0:
+            raise ValueError(
+                f"deadline_s must be >= 0 (0 = no deadline), got "
+                f"{deadline_s}")
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
         req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
-                      eos_token_id=eos_token_id)
+                      eos_token_id=eos_token_id,
+                      deadline_s=deadline_s if deadline_s else None)
         self.scheduler.submit(req)
+        self._drain_terminal_events()
         self._m_queue.set(self.scheduler.queue_depth)
         return req
+
+    def cancel(self, req: Request) -> bool:
+        """Cancel a request; returns True if it transitioned to
+        CANCELLED, False if it was already terminal (idempotent).  Safe
+        at any point BETWEEN dispatches (the serving loop is
+        single-threaded, so caller code always runs at an iteration
+        boundary): a RUNNING request's computed blocks are commit-cached
+        first — exactly like preemption — then freed, so a cancelled
+        request's prefix stays warm for shared-prefix siblings."""
+        with trace_span("serving/cancel", req=req.req_id):
+            ok = self.scheduler.cancel(req)
+        self._drain_terminal_events()
+        self._update_gauges()
+        return ok
+
+    def _drain_terminal_events(self) -> int:
+        """Fold the scheduler's non-OK terminal transitions into the
+        lifecycle counters (each event counted exactly once, whichever
+        path initiated it)."""
+        events = self.scheduler.terminal_events
+        if not events:
+            return 0
+        self.scheduler.terminal_events = []
+        by_status = {RequestStatus.CANCELLED: ("cancelled",
+                                               self._m_cancelled),
+                     RequestStatus.TIMED_OUT: ("timed_out",
+                                               self._m_timed_out),
+                     RequestStatus.SHED: ("shed", self._m_shed),
+                     RequestStatus.FAILED: ("failed", self._m_failed)}
+        for req in events:
+            key, counter = by_status[req.status]
+            counter.inc()
+            self.lifecycle_counts[key] += 1
+            logger.warning(f"serving: {req.req_id} -> {req.status.value}"
+                           f"{': ' + req.error if req.error else ''}")
+        return len(events)
 
     # ------------------------------------------------------------------
     # the one compiled program
@@ -192,8 +289,15 @@ class ServingEngine:
             first = engine._sample(chunk_logits[None], s_first,
                                    self.temperature, self.top_k,
                                    self.top_p)[0]
+            # per-slot finite flags, computed IN-PROGRAM (no extra
+            # dispatch, no retrace — decode_builds stays 1): a slot
+            # whose logits go non-finite is quarantined host-side
+            # instead of silently streaming garbage or poisoning the
+            # prefix cache
+            dec_finite = jnp.all(jnp.isfinite(dec_logits), axis=-1)
+            chunk_finite = jnp.all(jnp.isfinite(chunk_logits))
             return (nxt.astype(jnp.int32), first.astype(jnp.int32),
-                    cache["k"], cache["v"], rng)
+                    dec_finite, chunk_finite, cache["k"], cache["v"], rng)
 
         get_registry().counter("dstpu_jit_programs_built_total").inc()
         with self.engine.mesh:
@@ -203,11 +307,42 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # one scheduler iteration
     # ------------------------------------------------------------------
+    def _quarantine(self, slot: int, req: Request, where: str) -> None:
+        """Non-finite logits detected in ``slot``: the request FAILS and
+        its blocks are DISCARDED (freed without commit, registrations
+        dropped — suspect KV must never serve a prefix-cache hit), and
+        the batch continues; every other stream is untouched."""
+        msg = (f"non-finite logits at {where} (slot {slot}) after "
+               f"{len(req.output)} tokens — request quarantined, KV "
+               f"blocks discarded")
+        with trace_span("serving/quarantine", req=req.req_id, slot=slot):
+            self.scheduler.terminate_slot(slot, RequestStatus.FAILED,
+                                          msg, discard=True)
+        self._m_quarantined.inc()
+        self.lifecycle_counts["quarantined"] += 1
+        logger.error(f"serving: {req.req_id}: {msg}")
+
     def _dispatch(self, dec: List[Tuple[int, Request]],
-                  chunk: Optional[Tuple[int, Request, int, int]]) -> None:
+                  chunk: Optional[Tuple[int, Request, int, int]]
+                  ) -> Optional[int]:
         """One dispatch of the mixed program: a decode token for every
         slot in ``dec`` plus (optionally) one prompt chunk, then apply
-        the results to the scheduler's request records."""
+        the results to the scheduler's request records.  Returns the
+        progress made (decode tokens emitted + prefill tokens landed) —
+        the serving watchdog's heartbeat — or ``None`` when a transient
+        fault at the dispatch site skipped the dispatch: the caller
+        abandons the whole iteration (no budget charged, the same work
+        retries NEXT step; streams are delayed, never corrupted).  A
+        fatal fault raises :class:`ServingError`."""
+        try:
+            get_fault_injector().check("serving.dispatch")
+        except TransientIOError as e:
+            logger.warning(f"serving: transient dispatch fault — "
+                           f"iteration skipped, will retry: {e}")
+            return None
+        except FatalIOError as e:
+            raise ServingError(
+                f"fatal fault at serving dispatch: {e}") from e
         sched = self.scheduler
         tables = np.zeros((self.num_slots, self.max_pages), np.int32)
         lens = np.zeros((self.num_slots,), np.int32)
@@ -237,22 +372,31 @@ class ServingEngine:
                 spans.enter_context(
                     trace_span("serving/prefill_chunk", slot=c_slot,
                                start=c_start, tokens=c_len))
-            nxt, first, self._pool_k, self._pool_v, self._rng = \
-                self._step_fn(
-                    self.engine.params,
-                    getattr(self.engine, "_scales", None),
-                    self._pool_k, self._pool_v, tables, lens, dec_tokens,
-                    dec_active, chunk_ids,
-                    jnp.asarray(c_slot, jnp.int32),
-                    jnp.asarray(c_start, jnp.int32),
-                    jnp.asarray(c_len, jnp.int32), self._rng)
+            (nxt, first, dec_fin, chunk_fin, self._pool_k, self._pool_v,
+             self._rng) = self._step_fn(
+                self.engine.params,
+                getattr(self.engine, "_scales", None),
+                self._pool_k, self._pool_v, tables, lens, dec_tokens,
+                dec_active, chunk_ids,
+                jnp.asarray(c_slot, jnp.int32),
+                jnp.asarray(c_start, jnp.int32),
+                jnp.asarray(c_len, jnp.int32), self._rng)
             nxt = np.asarray(nxt)
-        if dec:
-            self._m_itl.observe(time.perf_counter() - t0)
-            self._m_tokens.inc(len(dec))
+            dec_fin = np.asarray(dec_fin)
+        # ITL = dispatch wall time only, captured BEFORE the host-side
+        # bookkeeping below (commit hashing, finishes, quarantines) so
+        # the histogram stays comparable across PRs
+        dispatch_dt = time.perf_counter() - t0
+        progress = 0
         for slot, req in dec:
+            if not bool(dec_fin[slot]):
+                # quarantine BEFORE any commit: the row(s) this dispatch
+                # wrote are suspect and must not register in the cache
+                self._quarantine(slot, req, "decode")
+                continue
             req.cached_tokens += 1
             req.output.append(int(nxt[slot]))
+            progress += 1
             if req.cached_tokens % self.block_size == 0:
                 # a decode-filled block just completed: register it so a
                 # preemption (or an identical resubmission) stays warm
@@ -260,31 +404,50 @@ class ServingEngine:
                                              req.cached_tokens)
             if req.done:
                 sched.finish(slot)
+        if dec:
+            self._m_itl.observe(dispatch_dt)
+            if progress:
+                self._m_tokens.inc(progress)
         if chunk is not None:
             req = chunk[1]
-            req.cached_tokens += c_len
-            self._m_prefill_tokens.inc(c_len)
-            self.allocator.commit_cached(req.req_id, req.prefix,
-                                         req.cached_tokens)
-            if req.cached_tokens >= req.prefill_target:
-                # the chunk that completed the prefix carries the first
-                # token (sampled from its last valid position)
-                req.output.append(int(first))
-                self._m_tokens.inc()
-                if req.first_token_time is None:
-                    req.first_token_time = time.perf_counter()
-                    self._m_ttft.observe(
-                        req.first_token_time - req.submit_time)
-                if req.done:
-                    sched.finish(chunk[0])
+            if not bool(np.asarray(chunk_fin)):
+                self._quarantine(chunk[0], req, "prefill chunk")
+            else:
+                req.cached_tokens += c_len
+                progress += c_len
+                self._m_prefill_tokens.inc(c_len)
+                self.allocator.commit_cached(req.req_id, req.prefix,
+                                             req.cached_tokens)
+                if req.cached_tokens >= req.prefill_target:
+                    # the chunk that completed the prefix carries the
+                    # first token (sampled from its last valid position)
+                    req.output.append(int(first))
+                    self._m_tokens.inc()
+                    if req.first_token_time is None:
+                        req.first_token_time = time.perf_counter()
+                        self._m_ttft.observe(
+                            req.first_token_time - req.submit_time)
+                    if req.done:
+                        sched.finish(chunk[0])
+        return progress
 
     def step(self) -> bool:
-        """One continuous-batching iteration: admit (taking prefix-cache
-        hits), guarantee KV capacity, then dispatch the mixed program —
-        one decode token for every live slot riding alongside up to
-        ``prefill_chunk_tokens`` of prompt chunks.  Returns True while
-        work remains."""
+        """One continuous-batching iteration: sweep deadlines, admit
+        (taking prefix-cache hits), guarantee KV capacity, then dispatch
+        the mixed program — one decode token for every live slot riding
+        alongside up to ``prefill_chunk_tokens`` of prompt chunks.
+        Returns True while work remains.
+
+        Robustness (docs/serving.md "Failure handling & overload"):
+        expired deadlines terminate WAITING and RUNNING requests at this
+        boundary; non-finite slots are quarantined inside the dispatch;
+        and the no-progress watchdog raises :class:`ServingError` with
+        scheduler diagnostics after ``serving.no_progress_steps``
+        consecutive iterations that moved nothing (no tokens, no prefill
+        chunks, no terminal transitions) while work remained."""
         sched = self.scheduler
+        finished_before = len(sched.finished)
+        sched.sweep_deadlines()
         # capacity BEFORE admission: running sequences claim their next
         # block first, so a fresh admission is never immediately chosen
         # as the preemption victim (which would discard the prefill
@@ -294,8 +457,10 @@ class ServingEngine:
             logger.info(f"serving: preempted {req.req_id} on KV pressure "
                         f"({req.preemptions} time(s))")
         sched.schedule_admissions()
+        self._drain_terminal_events()
         self._update_gauges()
 
+        progress = 0
         budget = self.chunk_tokens
         include_decode = True
         while True:
@@ -303,15 +468,61 @@ class ServingEngine:
             dec = sched.decoding_slots() if include_decode else []
             if not dec and chunk is None:
                 break
-            self._dispatch(dec, chunk)
+            dispatched = self._dispatch(dec, chunk)
+            if dispatched is None:
+                # transient dispatch fault: abandon the iteration — the
+                # chunk budget was NOT charged and the same decode/chunk
+                # work retries next step
+                break
+            progress += dispatched
             include_decode = False
             if chunk is None:
                 break
             budget -= chunk[3]
             if budget <= 0:
                 break
+        self._drain_terminal_events()
         self._update_gauges()
+        # terminal transitions count as progress: a sweep that expires
+        # requests, a quarantine, or a thrash-fail all MOVED state.
+        # Preemptions deliberately do not — a preemption-only iteration
+        # is exactly the livelock signature the watchdog exists for.
+        progress += len(sched.finished) - finished_before
+        if progress or not sched.has_work:
+            self._no_progress = 0
+        else:
+            self._no_progress += 1
+            if self.no_progress_steps and \
+                    self._no_progress >= self.no_progress_steps:
+                raise ServingError(self._diagnose(
+                    f"serving made no progress for {self._no_progress} "
+                    f"consecutive iterations (zero tokens, zero prefill, "
+                    f"zero terminal transitions) — scheduler wedged or "
+                    f"every dispatch faulted"))
         return sched.has_work
+
+    def _diagnose(self, headline: str) -> str:
+        """Scheduler + pool state snapshot for loud errors (watchdog,
+        non-drain): enough to see WHICH request is stuck and why."""
+        sched, alloc = self.scheduler, self.allocator
+        lines = [headline,
+                 f"  queue_depth={sched.queue_depth} "
+                 f"active_slots={sched.active_slots}/{self.num_slots} "
+                 f"pool used={alloc.num_used} free={alloc.num_free} "
+                 f"cached={alloc.num_cached} of {alloc.usable_blocks}"]
+        for slot, req in sorted(sched.running.items()):
+            lines.append(
+                f"  slot {slot}: {req.req_id} cached={req.cached_tokens}"
+                f"/{req.prefill_target} out={len(req.output)}"
+                f"/{req.max_new_tokens} preemptions={req.preemptions}"
+                f"{' PINNED' if sched.pinned(req) else ''}")
+        for req in list(sched.waiting)[:8]:
+            lines.append(f"  waiting: {req.req_id} "
+                         f"prompt={len(req.prompt)} "
+                         f"preemptions={req.preemptions}")
+        if sched.queue_depth > 8:
+            lines.append(f"  ... and {sched.queue_depth - 8} more waiting")
+        return "\n".join(lines)
 
     def _update_gauges(self) -> None:
         self._m_queue.set(self.scheduler.queue_depth)
@@ -327,18 +538,42 @@ class ServingEngine:
             self._m_evictions.inc(d)
             self._evictions_polled += d
 
+    def _default_max_steps(self) -> int:
+        """A generous drain bound computed from the queued work: enough
+        iterations to prefill and decode every request SERIALLY, times a
+        preemption-recompute allowance, plus slack for admission-only
+        and fault-skipped iterations.  Far above any healthy drain, so
+        hitting it means a scheduler bug — which is the point: ``run()``
+        without an explicit ``max_steps`` must never spin forever."""
+        sched = self.scheduler
+        work = list(sched.waiting) + list(sched.running.values())
+        if not work:
+            return 1
+        steps = 0
+        for r in work:
+            # worst-case prefix at a late re-admission includes every
+            # token the request may ever generate
+            prefix = len(r.prompt) + r.max_new_tokens
+            steps += -(-prefix // self.chunk_tokens) + r.max_new_tokens + 2
+        allowance = (sched.max_preemptions or 8) + 1
+        return steps * allowance + 64
+
     def run(self, max_steps: Optional[int] = None) -> List[Request]:
-        """Drain the queue; returns the finished requests.  A bounded
-        ``max_steps`` turns a scheduler bug into a loud error instead of
-        a spin."""
+        """Drain the queue; returns every terminal request — natural
+        completions (``status OK``) and cancelled / timed-out / shed /
+        failed ones alike (check ``req.status``).  ``max_steps`` bounds
+        the drain; ``None`` computes a generous bound from the queued
+        work (tokens, chunks, preemption allowance), so a scheduler bug
+        or a preemption livelock is a loud :class:`ServingError` with
+        diagnostics, never a silent spin."""
+        if max_steps is None:
+            max_steps = self._default_max_steps()
         steps = 0
         while self.step():
             steps += 1
-            if max_steps is not None and steps >= max_steps:
-                raise RuntimeError(
-                    f"serving did not drain within {max_steps} steps "
-                    f"({self.scheduler.queue_depth} queued, "
-                    f"{self.scheduler.active_slots} running)")
+            if steps >= max_steps:
+                raise ServingError(self._diagnose(
+                    f"serving did not drain within {max_steps} steps"))
         # a drained pool must hold zero sequence-referenced blocks
         # (cached-LRU blocks may remain — they are reclaimable capacity,
         # not leaks) — leak check

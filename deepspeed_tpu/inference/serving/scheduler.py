@@ -21,11 +21,32 @@ the iteration after its last chunk lands.
 State machine per request::
 
     WAITING --admit--> RUNNING --finish(eos | max_new)--> FINISHED
-       ^                  |
+       ^                  |                                (status OK)
        +---- preempt -----+   (KV pressure; re-enters at queue FRONT,
                                recompute-style — but prefix-cache hits
                                mean re-admission recomputes only the
                                uncached tail)
+
+plus the terminal lifecycle edges added by the robustness layer
+(docs/serving.md "Failure handling & overload") — each carries a
+:class:`RequestStatus` and lands the request in ``finished``:
+
+  * submit with a full queue        -> SHED       (never queued)
+  * ``cancel()`` (WAITING/RUNNING)  -> CANCELLED  (blocks freed at the
+                                       iteration boundary, commit-cached
+                                       first like preemption)
+  * deadline sweep                  -> TIMED_OUT  (WAITING and RUNNING)
+  * non-finite logits (quarantine), -> FAILED     (quarantine DISCARDS
+    thrash pin-or-fail, fatal                      the blocks: suspect
+    injected faults                                KV never parks in the
+                                                   prefix cache)
+
+Preemption-thrash guard: a request preempted ``max_preemptions`` times
+is PINNED — never chosen as a victim again, so it runs to completion
+while everyone else yields.  If the pool cannot grow and every running
+request is pinned, the growing request FAILS with a clear sizing error
+instead of livelocking ``ensure_decode_capacity()`` (two oversized
+requests can otherwise evict each other forever).
 
 Policies (deliberately simple and deterministic, pinned by tests):
 
@@ -53,6 +74,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional, Tuple
 
+from ...runtime.resilience.errors import FatalIOError, TransientIOError
+from ...runtime.resilience.fault_injection import get_fault_injector
 from .block_allocator import BlockPoolError, PagedBlockAllocator
 
 
@@ -60,6 +83,16 @@ class RequestState(enum.Enum):
     WAITING = "waiting"
     RUNNING = "running"
     FINISHED = "finished"
+
+
+class RequestStatus(enum.Enum):
+    """Terminal outcome of a request — ``None`` while in flight, set
+    exactly once when the request reaches FINISHED."""
+    OK = "ok"                  # ran to eos / max_new_tokens
+    CANCELLED = "cancelled"    # caller cancel(), applied at a boundary
+    TIMED_OUT = "timed_out"    # deadline_s exceeded (WAITING or RUNNING)
+    FAILED = "failed"          # quarantine / thrash pin-or-fail / fatal fault
+    SHED = "shed"              # rejected at submit: queue at max_queue_depth
 
 
 _req_counter = itertools.count()
@@ -86,6 +119,13 @@ class Request:
     #: prefill work this request never had to pay
     cache_hit_tokens: int = 0
     preemptions: int = 0
+    #: TTL in seconds from submit; swept every step() while WAITING or
+    #: RUNNING (terminal status TIMED_OUT).  None = no deadline.
+    deadline_s: Optional[float] = None
+    #: terminal outcome — None while in flight (docs/serving.md)
+    status: Optional[RequestStatus] = None
+    #: human-readable reason for a non-OK terminal status
+    error: Optional[str] = None
     submit_time: float = field(default_factory=time.perf_counter)
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
@@ -112,17 +152,32 @@ class Request:
 
 class ContinuousBatchingScheduler:
     def __init__(self, num_slots: int, allocator: PagedBlockAllocator,
-                 max_blocks_per_seq: int):
+                 max_blocks_per_seq: int, max_queue_depth: int = 0,
+                 max_preemptions: int = 0):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.num_slots = num_slots
         self.alloc = allocator
         self.max_blocks_per_seq = max_blocks_per_seq
+        #: submit() sheds beyond this many waiting requests (0 = unbounded)
+        self.max_queue_depth = max_queue_depth
+        #: preemption cap per request: at the cap the request is pinned
+        #: (never a victim again); 0 = no cap
+        self.max_preemptions = max_preemptions
         self.waiting: Deque[Request] = deque()
         self.running: Dict[int, Request] = {}      # slot -> request
         self._admit_order: List[int] = []          # slots, oldest first
         self.finished: List[Request] = []
         self.preemption_count = 0
+        #: non-OK terminal transitions since the engine last drained —
+        #: ALL terminal paths (shed, cancel, timeout, fail) append here,
+        #: so the engine's lifecycle counters see every event exactly once
+        self.terminal_events: List[Request] = []
+        #: req_ids whose table growth hit a transient fault THIS
+        #: iteration: they sit out the decode (their write position has
+        #: no block — dispatching would scatter into the null block) and
+        #: retry growth next step.  Cleared by ensure_decode_capacity.
+        self._growth_held: set = set()
 
     # -- introspection -----------------------------------------------------
     @property
@@ -142,15 +197,20 @@ class ContinuousBatchingScheduler:
 
     def decoding_slots(self) -> List[Tuple[int, Request]]:
         """Slots that take a decode token this iteration (admitted AND
-        past their prefill), in slot order for deterministic batches."""
+        past their prefill, not held by a transient growth fault), in
+        slot order for deterministic batches."""
         return [(s, r) for s, r in sorted(self.running.items())
-                if not r.prefilling]
+                if not r.prefilling and r.req_id not in self._growth_held]
 
     # -- lifecycle ---------------------------------------------------------
     def submit(self, req: Request) -> Request:
         """Queue a request. Validates it can EVER fit (prompt + new
         tokens within one slot's table and the pool) so admission never
-        deadlocks on an impossible head-of-line request."""
+        deadlocks on an impossible head-of-line request.  With
+        ``max_queue_depth`` set, a full queue SHEDS the request instead
+        of queueing it (bounded backpressure): the request comes back
+        terminal with ``status == RequestStatus.SHED`` and is never
+        admitted — the caller's 503, not an exception."""
         total = len(req.prompt) + req.max_new_tokens
         need = self.alloc.blocks_for_tokens(total)
         if not req.prompt:
@@ -166,8 +226,86 @@ class ContinuousBatchingScheduler:
                 f"may hold at most "
                 f"{min(self.max_blocks_per_seq, self.alloc.usable_blocks)}"
                 f" — raise serving.num_kv_blocks / max_out_tokens")
+        if self.max_queue_depth and \
+                len(self.waiting) >= self.max_queue_depth:
+            self._terminalize(
+                req, RequestStatus.SHED,
+                f"queue full: {len(self.waiting)} waiting >= "
+                f"serving.max_queue_depth ({self.max_queue_depth})")
+            return req
         self.waiting.append(req)
         return req
+
+    # -- terminal transitions ----------------------------------------------
+    def _terminalize(self, req: Request, status: RequestStatus,
+                     error: Optional[str] = None) -> Request:
+        """The ONE place a request reaches FINISHED: stamps status/
+        error/finish_time and records the event for the engine's
+        lifecycle counters (non-OK only — OK is counted by the token
+        path)."""
+        req.state = RequestState.FINISHED
+        req.status = req.status or status
+        req.error = error
+        req.finish_time = time.perf_counter()
+        self.finished.append(req)
+        if status is not RequestStatus.OK:
+            self.terminal_events.append(req)
+        return req
+
+    def terminate_slot(self, slot: int, status: RequestStatus,
+                       error: Optional[str] = None,
+                       discard: bool = False) -> Request:
+        """Terminally remove a RUNNING request at an iteration boundary.
+        Like preemption, computed blocks are commit-cached BEFORE the
+        free so a healthy request's prefix stays warm for siblings —
+        EXCEPT under ``discard`` (quarantine), where the KV content is
+        suspect and every block is unregistered instead."""
+        req = self.running.pop(slot)
+        self._admit_order.remove(slot)
+        if not discard:
+            self.alloc.commit_cached(req.req_id, req.prefix,
+                                     req.cached_tokens)
+        self.alloc.free(req.req_id, discard=discard)
+        return self._terminalize(req, status, error)
+
+    def cancel(self, req: Request,
+               status: RequestStatus = RequestStatus.CANCELLED,
+               error: Optional[str] = None) -> bool:
+        """Cancel a WAITING or RUNNING request; returns False when the
+        request is already terminal (idempotent).  RUNNING requests free
+        their KV safely — commit-cached first, exactly like preemption —
+        which is why the engine only calls this between dispatches."""
+        if req.state is RequestState.FINISHED:
+            return False
+        if req.state is RequestState.WAITING:
+            try:
+                self.waiting.remove(req)
+            except ValueError:
+                return False               # not queued (already handled)
+            self._terminalize(req, status, error)
+            return True
+        for slot, r in self.running.items():
+            if r is req:
+                self.terminate_slot(slot, status, error)
+                return True
+        return False
+
+    def sweep_deadlines(self, now: Optional[float] = None) -> List[Request]:
+        """Expire every WAITING and RUNNING request whose TTL has
+        passed (terminal status TIMED_OUT).  Called once per step(), so
+        expiry lands at an iteration boundary — a RUNNING request's
+        blocks are freed exactly like a cancellation."""
+        now = time.perf_counter() if now is None else now
+        expired = [
+            r for r in list(self.waiting) + list(self.running.values())
+            if r.deadline_s is not None
+            and now - r.submit_time > r.deadline_s]
+        for r in expired:
+            self.cancel(r, RequestStatus.TIMED_OUT,
+                        f"deadline {r.deadline_s:.3g}s exceeded "
+                        f"({now - r.submit_time:.3g}s since submit, "
+                        f"state was {r.state.value})")
+        return expired
 
     def schedule_admissions(self) -> List[Tuple[int, Request]]:
         """FCFS admission into free slots while the pool covers each
@@ -186,16 +324,33 @@ class ContinuousBatchingScheduler:
             # probe's hash walk is skipped while the full demand fits
             # outright, so an unpressured (or uncached-and-blocked)
             # head costs no per-iteration rehash of its prefix.
+            try:
+                get_fault_injector().check("serving.admission")
+            except TransientIOError:
+                break              # whole admission pass retries next step
+            except FatalIOError as e:
+                self.waiting.popleft()
+                self._terminalize(req, RequestStatus.FAILED,
+                                  f"fatal fault at admission: {e}")
+                continue
             need = self.alloc.blocks_for_tokens(len(req.prefix) + 1)
             if not self.alloc.can_allocate(need):
                 need = self.alloc.probe_fresh_need(len(req.prefix) + 1,
                                                    req.prefix)
             if not self.alloc.can_allocate(need):
                 break                      # head-of-line blocks: FCFS order
-            self.waiting.popleft()
             slot = min(set(range(self.num_slots)) - set(self.running))
-            _, cached = self.alloc.allocate(
-                req.req_id, len(req.prefix) + 1, token_ids=req.prefix)
+            try:
+                _, cached = self.alloc.allocate(
+                    req.req_id, len(req.prefix) + 1, token_ids=req.prefix)
+            except TransientIOError:
+                break              # req stays at the head; retry next step
+            except FatalIOError as e:
+                self.waiting.popleft()
+                self._terminalize(req, RequestStatus.FAILED,
+                                  f"fatal fault allocating KV blocks: {e}")
+                continue
+            self.waiting.popleft()
             req.state = RequestState.RUNNING
             req.prefill_target = len(req.prefix)
             req.cached_tokens = cached     # hit blocks skip prefill
@@ -228,29 +383,61 @@ class ContinuousBatchingScheduler:
         preempts until the rest fit — LIFO order, but preferring a
         victim whose blocks stay cache-resident (eviction then costs
         only its uncached tail on re-admission).  Returns the preempted
-        requests."""
+        requests.
+
+        Robustness edges: a transient injected/driver fault growing the
+        table HOLDS the sequence out of this iteration's decode (its
+        write position has no block) and retries next step — no
+        recompute, and a pinned request's preemption cap cannot be
+        breached by a fault; a fatal fault fails it.  When no
+        preemption victim exists because every running request is
+        pinned at the preemption cap, the growing request FAILS with a
+        sizing error — the thrash guard's pin-or-fail arm — instead of
+        spinning forever."""
         preempted: List[Request] = []
+        self._growth_held.clear()
         for slot in list(self._admit_order):           # oldest first
             req = self.running.get(slot)
             if req is None or req.prefilling:
                 continue
-            while True:
+            while req.state is RequestState.RUNNING:
                 need = self.alloc.blocks_for_tokens(req.cached_tokens + 1)
                 have = len(self.alloc.block_table(req.req_id))
                 if have >= need:
                     break
                 try:
                     self.alloc.append_block(req.req_id)
+                except TransientIOError:
+                    self._growth_held.add(req.req_id)  # sit out, retry
+                    break
+                except FatalIOError as e:
+                    self.terminate_slot(slot, RequestStatus.FAILED,
+                                        f"fatal fault growing KV table: {e}")
                 except BlockPoolError:
                     victim_slot = self._pick_victim()
+                    if victim_slot is None:
+                        self.terminate_slot(
+                            slot, RequestStatus.FAILED,
+                            f"KV pool cannot grow {req.req_id!r} "
+                            f"({have} blocks held, {need} needed) and "
+                            f"every running request is preemption-pinned "
+                            f"(cap {self.max_preemptions}) — the pool is "
+                            f"too small for the pinned working set; raise "
+                            f"serving.num_kv_blocks or lower "
+                            f"serving.max_batch_slots")
+                        break
                     victim = self.running[victim_slot]
                     self._preempt(victim_slot, victim)
                     preempted.append(victim)
-                    if victim is req:
-                        break              # evicted itself; next slot
         return preempted
 
-    def _pick_victim(self) -> int:
+    def pinned(self, req: Request) -> bool:
+        """Thrash guard: at the preemption cap a request becomes
+        non-preemptible and runs to completion while others yield."""
+        return self.max_preemptions > 0 and \
+            req.preemptions >= self.max_preemptions
+
+    def _pick_victim(self) -> Optional[int]:
         """LIFO preemption, cache-residency-aware: walk latest-admitted
         first and take the first victim whose full blocks are all
         registered in the prefix cache (freeing them parks the prefix
@@ -258,14 +445,21 @@ class ContinuousBatchingScheduler:
         its tail).  Falls back to the plain latest-admitted slot.  With
         the prefix cache disabled nothing is ever registered, so the
         walk would reduce to "prefer whoever holds zero full blocks" —
-        inverting LIFO against older short-prompt requests; skip it."""
+        inverting LIFO against older short-prompt requests; skip it.
+        Requests pinned at the preemption cap are never victims; with
+        every slot pinned there is no victim (None) and the caller
+        fails the grower instead of livelocking."""
+        eligible = [s for s in self._admit_order
+                    if not self.pinned(self.running[s])]
+        if not eligible:
+            return None
         if self.alloc.enable_prefix_cache:
-            for slot in reversed(self._admit_order):
+            for slot in reversed(eligible):
                 req = self.running[slot]
                 if self.alloc.is_cache_resident(req.req_id,
                                                 req.cached_tokens):
                     return slot
-        return self._admit_order[-1]
+        return eligible[-1]
 
     def _preempt(self, slot: int, req: Request) -> None:
         # register what was computed before letting the blocks go: the
@@ -290,7 +484,4 @@ class ContinuousBatchingScheduler:
         # them instead of re-prefilling
         self.alloc.commit_cached(req.req_id, req.prefix, req.cached_tokens)
         self.alloc.free(req.req_id)
-        req.state = RequestState.FINISHED
-        req.finish_time = time.perf_counter()
-        self.finished.append(req)
-        return req
+        return self._terminalize(req, RequestStatus.OK)

@@ -94,6 +94,11 @@ def _kernel(len_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
         l_scr[...] = alpha * l_scr[...] + jnp.sum(probs, axis=0,
                                                   keepdims=True)
         v = v_ref[...].astype(jnp.float32)            # [block, Hkv, D]
+        # masked rows get probability ~0, but 0 * NaN = NaN: zero the v
+        # rows past the valid length so a recycled pool block holding a
+        # quarantined request's non-finite KV cannot re-poison its next
+        # owner (masked rows tolerate ANY stale content, not just finite)
+        v = jnp.where((pos[:, :1] < length)[..., None], v, 0.0)
         if groups == 1:
             pv = jnp.sum(probs[:, :, None] * v, axis=0)       # [H, D]
         else:
@@ -221,6 +226,10 @@ def _prefill_kernel(meta_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
         probs = jnp.exp(scores - m_new[..., None])    # [G, C, block]
         l_scr[...] = alpha * l_scr[...] + jnp.sum(probs, axis=-1)
         v = v_ref[...].astype(jnp.float32)            # [block, D]
+        # rows at/past total carry recycled-pool garbage that may be
+        # non-finite (quarantine discards): zero them — masked probs are
+        # ~0 but 0 * NaN would still poison the accumulator
+        v = jnp.where((pos[0, 0, :] < total)[:, None], v, 0.0)
         pv = jax.lax.dot_general(
             probs, v, (((2,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # [G, C, D]
@@ -337,6 +346,8 @@ def paged_prefill_reference(q, pool_k, pool_v, base, chunk_len,
     qpos = base + jnp.arange(c)[:, None, None]
     s = jnp.where((pos <= qpos) & (pos < base + chunk_len), s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
+    v = jnp.where((jnp.arange(npages * block) < base + chunk_len)
+                  [:, None, None], v, 0.0)   # NaN-safe masked rows
     out = jnp.einsum("chs,shd->chd", p, v.astype(jnp.float32))
     valid = (jnp.arange(c) < chunk_len)[:, None, None]
     return jnp.where(valid, out, 0.0).astype(q.dtype)
@@ -362,6 +373,8 @@ def paged_attention_reference(q, pool_k, pool_v, lengths, block_tables):
                        k.astype(jnp.float32)) / math.sqrt(d)
         s = jnp.where(jnp.arange(npages * block)[None] < length, s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
+        v = jnp.where(jnp.arange(npages * block)[:, None, None] < length,
+                      v, 0.0)                # NaN-safe masked rows
         out = jnp.einsum("hs,shd->hd", p, v.astype(jnp.float32))
         return jnp.where(length > 0, out, 0.0).astype(qi.dtype)
 

@@ -353,6 +353,9 @@ class ResilienceConfig(ConfigModel):
     # -- fault injection (runtime/resilience/fault_injection.py) --
     # {"site": {"kind": "fail|fatal|truncate|delay|kill",
     #           "at": 1, "count": 1, "arg": 0}}
+    # sites cover checkpoint/slot-store I/O AND the serving stack
+    # (serving.allocate / append_block / admission / dispatch — see
+    # docs/serving.md "Failure handling & overload")
     fault_injection: Dict[str, Any] = Field(default_factory=dict)
 
     @model_validator(mode="after")

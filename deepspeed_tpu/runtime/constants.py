@@ -125,6 +125,24 @@ SERVING_PREFILL_CHUNK_TOKENS_DEFAULT = 256
 # content-addressed prefix caching over the paged pool (RadixAttention-
 # style block reuse): hit full blocks skip prefill
 SERVING_PREFIX_CACHE_DEFAULT = True
+# overload control: submit() sheds (terminal SHED status, never queued)
+# beyond this many waiting requests — bounded backpressure instead of an
+# unbounded deque; 0 = unbounded (the pre-robustness behavior)
+SERVING_MAX_QUEUE_DEPTH_DEFAULT = 1024
+# preemption-thrash guard: a request preempted this many times becomes
+# PINNED (never chosen as a victim again, runs to completion); when every
+# running request is pinned and the pool still cannot grow, the growing
+# request FAILS with a clear error instead of livelocking; 0 = no cap
+SERVING_MAX_PREEMPTIONS_DEFAULT = 8
+# serving watchdog: this many consecutive scheduler iterations with zero
+# progress (no tokens, no prefill chunks, no admissions, no terminal
+# transitions while work remains) raise a loud ServingError with full
+# scheduler diagnostics; 0 disables
+SERVING_NO_PROGRESS_STEPS_DEFAULT = 64
+# default per-request TTL (submit -> terminal), swept every step() for
+# WAITING and RUNNING requests; 0 = no deadline. submit(deadline_s=...)
+# overrides per request.
+SERVING_DEFAULT_DEADLINE_S_DEFAULT = 0.0
 
 ROUTE_TRAIN = "train"
 ROUTE_EVAL = "eval"

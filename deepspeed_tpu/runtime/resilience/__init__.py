@@ -17,7 +17,8 @@ runtime/engine (non-finite grad-norm skip-step), inference/engine
 (runtime/config.py); docs: docs/resilience.md.
 """
 from .errors import (CheckpointCorruptionError, FatalIOError,
-                     TRANSIENT_ERRNOS, TransientIOError, is_transient)
+                     ServingError, TRANSIENT_ERRNOS, TransientIOError,
+                     is_transient)
 from .fault_injection import (ENV_FAULTS, FaultInjector, FaultPlan,
                               get_fault_injector, install_fault_injector)
 from .heartbeat import (ENV_HEARTBEAT_FILE, Heartbeat, Watchdog, beat,
@@ -31,8 +32,8 @@ from .retry import (DEFAULT_IO_POLICY, RetryPolicy, policy_from_config,
                     retriable, retry_call)
 
 __all__ = [
-    "CheckpointCorruptionError", "FatalIOError", "TRANSIENT_ERRNOS",
-    "TransientIOError", "is_transient",
+    "CheckpointCorruptionError", "FatalIOError", "ServingError",
+    "TRANSIENT_ERRNOS", "TransientIOError", "is_transient",
     "ENV_FAULTS", "FaultInjector", "FaultPlan", "get_fault_injector",
     "install_fault_injector",
     "ENV_HEARTBEAT_FILE", "Heartbeat", "Watchdog", "beat", "heartbeat_age",

@@ -35,6 +35,18 @@ class CheckpointCorruptionError(FatalIOError):
     tag exists."""
 
 
+class ServingError(RuntimeError):
+    """The serving stack (inference/serving/) cannot make progress or
+    detected an invariant violation: no-progress watchdog trips,
+    preemption-thrash pin-or-fail, fatal dispatch faults, and the block
+    pool's own :class:`BlockPoolError` all branch here — a serving bug
+    or an undersized deployment surfaces loudly, never as a silent
+    spin or a corrupted KV cache.  Deliberately NOT an ``OSError``:
+    nothing in this family is retriable I/O (``is_transient`` is never
+    True for it) — the remedies are scheduling decisions (shed, fail
+    the request, raise to the operator), not the retry layer."""
+
+
 #: OS errnos worth retrying: device/queue blips and interrupted syscalls.
 #: Deliberately excludes ENOSPC/EROFS/EACCES/ENOENT — repeating those
 #: just repeats the failure.

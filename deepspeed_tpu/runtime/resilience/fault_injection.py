@@ -12,6 +12,15 @@ instrumented code paths:
     infinity.slot_read     one ZeRO-Infinity slot .npz open
     slot_store.write       one NVMe slot-store pwrite submission
     slot_store.read        one NVMe slot-store pread submission
+    serving.allocate       one paged-KV block-table allocation (admission)
+    serving.append_block   one paged-KV block-table growth (decode boundary)
+    serving.admission      one serving-scheduler admission attempt
+    serving.dispatch       one mixed-step program dispatch
+
+The serving sites feed the continuous-batching chaos suite
+(tests/unit/test_serving_chaos.py, docs/serving.md "Failure handling"):
+``fail`` there exercises the retry-next-step / hold-this-iteration
+paths, ``fatal`` the per-request FAILED terminal path.
 
 Fault kinds:
 

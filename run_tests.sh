@@ -91,6 +91,30 @@ if [[ -z "$FILTER" || "inference" == *"$FILTER"* || "serving" == *"$FILTER"* ]];
   fi
 fi
 
+# Serving-chaos sweep: the `chaos`-marked suite (randomized cancels,
+# deadlines, quarantine, preemption) replayed across a DSTPU_FAULTS
+# matrix over the serving injection sites — every schedule must drain
+# leak-free with OK streams exact (docs/serving.md "Failure handling").
+if [[ -z "$FILTER" || "chaos" == *"$FILTER"* || "serving" == *"$FILTER"* ]]; then
+  CHAOS_MATRIX=(
+    ""
+    "serving.admission=fail:2:2"
+    "serving.allocate=fail:1:2;serving.dispatch=fail:3:2"
+    "serving.append_block=fail:2:1"
+    "serving.dispatch=fail:2:3;serving.admission=fail:3:1"
+  )
+  for faults in "${CHAOS_MATRIX[@]}"; do
+    echo "=== serving-chaos sweep (DSTPU_FAULTS='${faults}')"
+    if DSTPU_FAULTS="$faults" JAX_PLATFORMS=cpu python -m pytest \
+         tests/unit/test_serving_chaos.py -m chaos -q --tb=short \
+         ${EXTRA_PYTEST_ARGS:-}; then
+      PASSED=$((PASSED + 1))
+    else
+      FAILED+=("serving-chaos [DSTPU_FAULTS=${faults}]")
+    fi
+  done
+fi
+
 echo
 echo "=== suite: $PASSED module(s) green, ${#FAILED[@]} failed" \
      "($(($(date +%s) - T0))s)"

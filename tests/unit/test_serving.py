@@ -11,7 +11,15 @@ Coverage model:
   * the acceptance integration test: >= 8 concurrent requests with
     staggered arrivals whose token streams are identical to sequential
     ``generate()`` per request, while the compiled decode step traces
-    exactly once (build counter pinned).
+    exactly once (build counter pinned);
+  * robustness (ISSUE 6, docs/serving.md "Failure handling &
+    overload"): terminal statuses + cancel/deadline/shed at scheduler
+    and engine level, the preemption-thrash pin-or-fail guard, NaN
+    quarantine via the in-program finite flags (batch unaffected, KV
+    discarded), the no-progress watchdog, run()'s computed drain bound,
+    the fully-cached-prefix admission edge, and the fault-injection
+    sites (transient = delay, fatal = one request FAILED).  The
+    randomized chaos suite lives in ``test_serving_chaos.py``.
 """
 import jax
 import jax.numpy as jnp
@@ -22,12 +30,24 @@ import deepspeed_tpu as ds
 from deepspeed_tpu.inference.serving import (BlockPoolError,
                                              ContinuousBatchingScheduler,
                                              PagedBlockAllocator, Request,
-                                             RequestState)
+                                             RequestState, RequestStatus,
+                                             ServingError)
+from deepspeed_tpu.runtime.resilience import (FaultInjector,
+                                              install_fault_injector)
 from deepspeed_tpu.models import TransformerLM, gpt2_config
 from deepspeed_tpu.ops.transformer.paged_decode_attention import (
     paged_attention_reference, paged_decode_attention, supports)
 
 pytestmark = pytest.mark.inference
+
+
+@pytest.fixture
+def injector():
+    """A fresh process-global FaultInjector for the test, restored to an
+    empty one afterwards (so plans never leak across tests)."""
+    fi = install_fault_injector(FaultInjector())
+    yield fi
+    install_fault_injector(FaultInjector())
 
 
 # ---------------------------------------------------------------------------
@@ -36,8 +56,10 @@ pytestmark = pytest.mark.inference
 def make_case(lens, bs, nb, h=4, hkv=4, d=32, seed=0, garbage=None):
     """Random pools + a disjoint shuffled block table per slot.  Tail
     rows of each slot's last page can be filled with ``garbage`` to
-    prove the per-slot length mask (stale pool contents must be finite,
-    like a real pool's — they are masked, not multiplied by zero)."""
+    prove the per-slot length mask — including NaN garbage, which a
+    recycled block can genuinely hold after a quarantine discard (the
+    kernels zero masked v rows, so 0 x NaN never reaches the
+    accumulator)."""
     rng = np.random.default_rng(seed)
     b = len(lens)
     q = rng.standard_normal((b, h, d)).astype(np.float32)
@@ -81,16 +103,20 @@ class TestPagedDecodeKernel:
         assert (out[1] == 0).all() and (out[3] == 0).all()
         np.testing.assert_allclose(out, ref, atol=2e-5)
 
-    def test_padded_tail_page_garbage_masked(self):
+    @pytest.mark.parametrize("garbage", [1e4, np.nan])
+    def test_padded_tail_page_garbage_masked(self, garbage):
         """Stale rows past a slot's length in its last page must not
         leak into the softmax (they are exactly what a recycled pool
-        block contains)."""
+        block contains) — including NON-FINITE rows, which a block
+        discarded by the quarantine path genuinely holds until its next
+        owner overwrites them."""
         q, pk, pv, ln, bt = make_case([13, 21], bs=16, nb=8,
-                                      garbage=1e4)
-        out = paged_decode_attention(q, pk, pv, ln, bt, interpret=True)
+                                      garbage=garbage)
+        out = np.asarray(
+            paged_decode_attention(q, pk, pv, ln, bt, interpret=True))
+        assert np.isfinite(out).all()
         ref = paged_attention_reference(q, pk, pv, ln, bt)
-        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                                   atol=2e-5)
+        np.testing.assert_allclose(out, np.asarray(ref), atol=2e-5)
 
     def test_gqa_parity(self):
         """kv heads < query heads: the pool stays at kv width and the
@@ -188,16 +214,20 @@ class TestPagedPrefillKernel:
         np.testing.assert_allclose(np.asarray(out)[:6],
                                    np.asarray(ref)[:6], atol=2e-5)
 
-    def test_stale_tail_garbage_masked(self):
+    @pytest.mark.parametrize("garbage", [1e4, np.nan])
+    def test_stale_tail_garbage_masked(self, garbage):
         """Rows past base+chunk_len in the last page are recycled-pool
-        garbage — they must be masked, not multiplied away."""
+        garbage — possibly NON-FINITE after a quarantine discard — and
+        must be masked without poisoning the accumulator."""
         q, pk, pv, b, cl, bt = make_prefill_case(5, 6, 8, bs=8, nb=8,
-                                                 garbage=1e4)
+                                                 garbage=garbage)
         from deepspeed_tpu.ops.transformer.paged_decode_attention import (
             paged_prefill_attention, paged_prefill_reference)
-        out = paged_prefill_attention(q, pk, pv, b, cl, bt, interpret=True)
+        out = np.asarray(
+            paged_prefill_attention(q, pk, pv, b, cl, bt, interpret=True))
+        assert np.isfinite(out[:6]).all()
         ref = paged_prefill_reference(q, pk, pv, b, cl, bt)
-        np.testing.assert_allclose(np.asarray(out)[:6],
+        np.testing.assert_allclose(out[:6],
                                    np.asarray(ref)[:6], atol=2e-5)
 
     def test_zero_length_chunk_returns_finite(self):
@@ -837,6 +867,192 @@ class TestServingEngine:
             eng.serving_engine()
 
 
+# ---------------------------------------------------------------------------
+# request lifecycle: terminal statuses, cancel, deadlines, shedding
+# (host-side scheduler/allocator level — docs/serving.md "Failure
+# handling & overload")
+# ---------------------------------------------------------------------------
+def test_serving_config_validates_robustness_knobs():
+    from deepspeed_tpu.inference.config import ServingConfig
+    assert ServingConfig().max_queue_depth == 1024
+    assert ServingConfig().max_preemptions == 8
+    assert ServingConfig().no_progress_steps == 64
+    assert ServingConfig().default_deadline_s == 0.0
+    for bad in ({"max_queue_depth": -1}, {"max_preemptions": -2},
+                {"no_progress_steps": -1}, {"default_deadline_s": -0.5}):
+        with pytest.raises(ValueError, match=next(iter(bad))):
+            ServingConfig(**bad)
+
+
+class TestLifecycleScheduler:
+    def test_shed_on_full_queue(self):
+        s, _ = mk_sched(slots=1, blocks=16)
+        s.max_queue_depth = 2
+        r1 = s.submit(Request(prompt=[1], max_new_tokens=2))
+        r2 = s.submit(Request(prompt=[2], max_new_tokens=2))
+        r3 = s.submit(Request(prompt=[3], max_new_tokens=2))
+        assert r3.status is RequestStatus.SHED
+        assert r3.state is RequestState.FINISHED
+        assert "max_queue_depth" in r3.error
+        assert list(s.waiting) == [r1, r2]
+        assert s.terminal_events == [r3]
+        s.schedule_admissions()
+        assert r3 not in s.running.values()    # shed is terminal
+
+    def test_cancel_waiting_request(self):
+        s, a = mk_sched(slots=1)
+        r1 = s.submit(Request(prompt=[1, 2], max_new_tokens=4))
+        r2 = s.submit(Request(prompt=[3], max_new_tokens=4))
+        s.schedule_admissions()                # r1 RUNNING, r2 WAITING
+        assert s.cancel(r2)
+        assert r2.status is RequestStatus.CANCELLED
+        assert s.queue_depth == 0 and r1.state is RequestState.RUNNING
+        a.assert_consistent()
+
+    def test_cancel_running_frees_blocks(self):
+        s, a = mk_sched(slots=2)
+        r1 = s.submit(Request(prompt=[1, 2, 3], max_new_tokens=4))
+        s.schedule_admissions()
+        assert r1.state is RequestState.RUNNING and a.num_used > 0
+        assert s.cancel(r1)
+        assert r1.status is RequestStatus.CANCELLED
+        assert a.num_used == 0 and not s.has_work
+        a.assert_consistent()
+
+    def test_cancel_terminal_is_noop(self):
+        s, _ = mk_sched()
+        r = s.submit(Request(prompt=[1, 2], max_new_tokens=1))
+        [(slot, _)] = s.schedule_admissions()
+        s.finish(slot)
+        assert r.status is RequestStatus.OK
+        assert not s.cancel(r)                 # idempotent on terminal
+        assert r.status is RequestStatus.OK    # OK not overwritten
+
+    def test_deadline_sweep_waiting_and_running(self):
+        s, a = mk_sched(slots=1)
+        r1 = s.submit(Request(prompt=[1, 2], max_new_tokens=4,
+                              deadline_s=5.0))
+        r2 = s.submit(Request(prompt=[3], max_new_tokens=4,
+                              deadline_s=50.0))
+        r3 = s.submit(Request(prompt=[4], max_new_tokens=4))  # no TTL
+        s.schedule_admissions()                # r1 RUNNING, r2/r3 WAITING
+        expired = s.sweep_deadlines(now=r1.submit_time + 10.0)
+        assert expired == [r1]                 # RUNNING expiry frees KV
+        assert r1.status is RequestStatus.TIMED_OUT
+        assert "deadline" in r1.error and a.num_used == 0
+        expired = s.sweep_deadlines(now=r2.submit_time + 100.0)
+        assert expired == [r2]                 # WAITING expiry dequeues
+        assert r2.status is RequestStatus.TIMED_OUT
+        assert list(s.waiting) == [r3]         # no deadline: never swept
+        a.assert_consistent()
+
+    def test_pinned_request_never_victim(self):
+        # the thrash guard's pin arm: at the cap, LIFO would evict r2,
+        # but r2 is pinned so the older r1 yields instead
+        alloc = PagedBlockAllocator(6, 4)      # 5 usable
+        s = ContinuousBatchingScheduler(2, alloc, 8, max_preemptions=2)
+        r1 = s.submit(Request(prompt=[1, 2, 3], max_new_tokens=12))
+        r2 = s.submit(Request(prompt=[4, 5, 6], max_new_tokens=12))
+        s.schedule_admissions()
+        for r in (r1, r2):
+            r.cached_tokens = 3
+            r.prefill_target = 3
+            r.output.append(7)
+        r2.preemptions = 2                     # pinned
+        preempted = []
+        for _ in range(12):
+            r1.cached_tokens += 1
+            r2.cached_tokens += 1
+            preempted = s.ensure_decode_capacity()
+            if preempted:
+                break
+        assert preempted == [r1], \
+            "pinned r2 must never be the victim — older r1 yields"
+        assert r2.state is RequestState.RUNNING
+        alloc.assert_consistent()
+
+    def test_transient_growth_fault_holds_not_preempts(self, injector):
+        # a transient append_block fault must HOLD the slot for one
+        # iteration (no decode — its write position has no block), not
+        # recompute-preempt it: a pinned request's cap stays unbreached
+        alloc = PagedBlockAllocator(8, 4)
+        s = ContinuousBatchingScheduler(2, alloc, 8, max_preemptions=1)
+        r1 = s.submit(Request(prompt=[1, 2, 3], max_new_tokens=8))
+        s.schedule_admissions()
+        r1.cached_tokens = 4
+        r1.prefill_target = 3
+        r1.output.append(7)
+        r1.preemptions = 1                     # pinned
+        injector.add_plan("serving.append_block", "fail", at=1, count=1)
+        assert s.ensure_decode_capacity() == []
+        assert r1.preemptions == 1             # cap NOT breached
+        assert r1.state is RequestState.RUNNING
+        assert s.decoding_slots() == []        # held: sits out this step
+        assert s.ensure_decode_capacity() == []    # retry succeeds
+        assert [r for _, r in s.decoding_slots()] == [r1]
+        alloc.assert_consistent()
+
+    def test_thrash_guard_all_pinned_fails_loudly(self):
+        # pin-or-fail: both requests at the cap, pool dry -> the grower
+        # FAILS with a sizing error instead of livelocking
+        alloc = PagedBlockAllocator(4, 4)      # 3 usable
+        s = ContinuousBatchingScheduler(2, alloc, 8, max_preemptions=1)
+        r1 = s.submit(Request(prompt=[1, 2, 3], max_new_tokens=8))
+        r2 = s.submit(Request(prompt=[4, 5, 6], max_new_tokens=8))
+        s.schedule_admissions()                # one block each, one free
+        for r in (r1, r2):
+            r.cached_tokens = 4                # at a block boundary
+            r.prefill_target = 3
+            r.output.append(7)
+            r.preemptions = 1                  # both pinned
+        preempted = s.ensure_decode_capacity()
+        assert preempted == []                 # nobody was evicted
+        assert r1.state is RequestState.RUNNING    # grew into the free block
+        assert r2.status is RequestStatus.FAILED   # pool dry, all pinned
+        assert "preemption-pinned" in r2.error
+        assert s.terminal_events == [r2]
+        alloc.assert_consistent()
+
+
+class TestCachedPrefixAdmissionEdge:
+    """The fully-cached-prefix admission edge (ISSUE 6 satellite): a
+    prompt whose length is an exact block multiple, resubmitted after
+    its blocks were committed, must NOT admit fully cached — the last
+    full block is held back so at least one position's logits are
+    computed (otherwise `_dispatch` would read `req.output[-1]` off an
+    empty output: IndexError)."""
+
+    def test_exact_multiple_holds_back_last_block(self):
+        a = PagedBlockAllocator(16, 4)
+        ids = list(range(8))                   # exactly 2 full blocks
+        a.allocate("s1", 9, token_ids=ids)
+        a.commit_cached("s1", ids, 8)
+        a.free("s1")                           # both blocks parked + hittable
+        _, cached = a.allocate("s2", 9, token_ids=ids)
+        assert cached == 4                     # NOT 8: one block held back
+        a.free("s2")
+        a.assert_consistent()
+
+    def test_admission_always_leaves_prefill_work(self):
+        # scheduler-level: a resubmitted exact-multiple prompt admits
+        # PREFILLING (cached_tokens < prefill_target), never straight to
+        # decode with an empty output
+        s, a = mk_sched(slots=2, blocks=16, bs=4)
+        ids = list(range(8))
+        r1 = s.submit(Request(prompt=ids, max_new_tokens=2))
+        [(slot, _)] = s.schedule_admissions()
+        r1.cached_tokens = 8                   # prefill landed
+        a.commit_cached(r1.req_id, ids, 8)
+        s.finish(slot)
+        r2 = s.submit(Request(prompt=ids, max_new_tokens=2))
+        s.schedule_admissions()
+        assert r2.state is RequestState.RUNNING
+        assert r2.cached_tokens < r2.prefill_target, \
+            "fully-cached admission would IndexError in _dispatch"
+        assert r2.prefilling and not r2.output
+        a.assert_consistent()
+
+
 class TestThroughputAccounting:
     def test_batched_decode_beats_sequential_dispatch_count(self):
         """Continuous batching's throughput lever in dispatch terms: N
@@ -852,3 +1068,245 @@ class TestThroughputAccounting:
         # 4 requests x 8 tokens each, but batched: 8 decode iterations
         # (+1 admission step), nowhere near the 32 sequential ones
         assert steps <= 10, steps
+
+
+# ---------------------------------------------------------------------------
+# robustness, engine level: lifecycle end-to-end, quarantine, watchdog,
+# thrash guard, fault-injection sites (docs/serving.md "Failure handling
+# & overload").  slow: each builds an interpret-mode serving engine.
+# ---------------------------------------------------------------------------
+def _generate(eng, prompt, n):
+    return np.asarray(eng.generate(np.asarray(prompt, np.int32)[None],
+                                   max_new_tokens=n, temperature=0.0))[0]
+
+
+@pytest.mark.slow
+class TestLifecycleEngine:
+    def test_cancel_and_deadline_streams_unaffected(self):
+        """Cancel a RUNNING request and expire a WAITING one mid-serve:
+        the survivor's stream stays token-identical to generate(), the
+        pool drains clean, one compiled program throughout."""
+        eng, srv = serving_engine(serving={"max_batch_slots": 2})
+        rs = np.random.RandomState(41)
+        p_ok, p_cancel, p_wait = [rs.randint(0, 64, (n,)).tolist()
+                                  for n in (7, 9, 6)]
+        with pytest.raises(ValueError, match="deadline_s"):
+            srv.submit(p_ok, max_new_tokens=2, deadline_s=-1.0)
+        r_ok = srv.submit(p_ok, max_new_tokens=8)
+        r_cancel = srv.submit(p_cancel, max_new_tokens=8)
+        r_wait = srv.submit(p_wait, max_new_tokens=8)   # no free slot
+        srv.step()
+        srv.step()
+        assert r_cancel.state is RequestState.RUNNING
+        assert srv.cancel(r_cancel)
+        assert r_cancel.status is RequestStatus.CANCELLED
+        assert not srv.cancel(r_cancel)                 # idempotent
+        # expire r_wait deterministically: backdate its submit clock
+        r_wait.deadline_s = 1.0
+        r_wait.submit_time -= 100.0
+        finished = srv.run()
+        assert len(finished) == 3
+        assert r_wait.status is RequestStatus.TIMED_OUT
+        assert r_ok.status is RequestStatus.OK
+        np.testing.assert_array_equal(np.asarray(r_ok.output),
+                                      _generate(eng, p_ok, 8))
+        assert srv.decode_builds == 1
+        assert srv.allocator.num_used == 0
+        assert srv.lifecycle_counts["cancelled"] == 1
+        assert srv.lifecycle_counts["timed_out"] == 1
+
+    def test_shed_on_overload(self):
+        """Bounded backpressure: beyond max_queue_depth, submit()
+        returns the request terminal (SHED) instead of queueing it."""
+        eng, srv = serving_engine(
+            serving={"max_batch_slots": 1, "max_queue_depth": 1})
+        rs = np.random.RandomState(43)
+        p1, p2, p3 = [rs.randint(0, 64, (6,)).tolist() for _ in range(3)]
+        r1 = srv.submit(p1, max_new_tokens=4)           # queued
+        r2 = srv.submit(p2, max_new_tokens=4)           # queue full: shed
+        assert r2.status is RequestStatus.SHED and r2.output == []
+        assert srv.lifecycle_counts["shed"] == 1
+        srv.run()
+        assert r1.status is RequestStatus.OK
+        np.testing.assert_array_equal(np.asarray(r1.output),
+                                      _generate(eng, p1, 4))
+        # capacity freed: a later submit is accepted again
+        r3 = srv.submit(p3, max_new_tokens=4)
+        srv.run()
+        assert r3.status is RequestStatus.OK
+
+    def test_poisoned_slot_quarantined_batch_unaffected(self):
+        """Fault isolation: NaN KV in ONE slot's pool blocks trips the
+        in-program finite flag; that request FAILS (KV discarded, never
+        cache-hittable), every other stream is token-identical to
+        generate(), and the program never retraces."""
+        eng, srv = serving_engine()
+        rs = np.random.RandomState(47)
+        prompts = [rs.randint(0, 64, (n,)).tolist() for n in (6, 9, 7)]
+        reqs = [srv.submit(p, max_new_tokens=8) for p in prompts]
+        srv.step()
+        srv.step()
+        victim = reqs[1]
+        assert victim.state is RequestState.RUNNING
+        blocks = srv.allocator.block_table(victim.req_id)
+        srv._pool_k = srv._pool_k.at[:, blocks[0]].set(jnp.nan)
+        finished = srv.run()
+        assert len(finished) == 3
+        assert victim.status is RequestStatus.FAILED
+        assert "quarantined" in victim.error
+        assert srv.lifecycle_counts["quarantined"] == 1
+        assert srv.lifecycle_counts["failed"] == 1
+        for p, r in zip(prompts, reqs):
+            if r is victim:
+                continue
+            assert r.status is RequestStatus.OK
+            np.testing.assert_array_equal(np.asarray(r.output),
+                                          _generate(eng, p, 8),
+                                          err_msg=f"prompt {p}")
+        assert srv.decode_builds == 1
+        srv.allocator.assert_consistent()
+        assert srv.allocator.num_used == 0
+        # discarded means discarded: resubmitting the poisoned prompt
+        # hits nothing (its registrations were dropped) and serves a
+        # CLEAN stream off freshly computed KV
+        r2 = srv.submit(prompts[1], max_new_tokens=8)
+        srv.run()
+        assert r2.cache_hit_tokens == 0
+        assert r2.status is RequestStatus.OK
+        np.testing.assert_array_equal(np.asarray(r2.output),
+                                      _generate(eng, prompts[1], 8))
+
+    def test_no_progress_watchdog_raises_with_diagnostics(self, injector):
+        """Every dispatch faulted forever -> zero progress while work
+        remains -> the watchdog raises ServingError with scheduler
+        diagnostics instead of spinning."""
+        eng, srv = serving_engine(serving={"no_progress_steps": 4})
+        injector.add_plan("serving.dispatch", "fail", at=1, count=-1)
+        rs = np.random.RandomState(53)
+        srv.submit(rs.randint(0, 64, (6,)).tolist(), max_new_tokens=4)
+        with pytest.raises(ServingError, match="no progress") as exc:
+            for _ in range(10):
+                srv.step()
+        msg = str(exc.value)
+        assert "queue_depth=" in msg and "pool" in msg
+
+    def test_preemption_thrash_bounded_and_terminates(self):
+        """ISSUE 6 satellite: two requests whose combined KV demand
+        exceeds the pool, alternately evicting each other — the
+        preemption cap pins the loser, both run to completion, and
+        dstpu_serving_preemptions_total stays bounded by the cap."""
+        from deepspeed_tpu.observability import get_registry
+        preempt_before = get_registry().counter(
+            "dstpu_serving_preemptions_total").value
+        cfg = gpt2_config("125m", num_layers=2, d_model=32, num_heads=4,
+                          vocab_size=64, max_seq_len=64,
+                          dtype=jnp.float32)
+        cap = 2
+        eng, srv = serving_engine(
+            serving={"kv_block_size": 4, "num_kv_blocks": 8,
+                     "max_batch_slots": 2, "prefill_chunk_tokens": 16,
+                     "max_preemptions": cap},
+            model_cfg=cfg, max_out_tokens=28)
+        rs = np.random.RandomState(59)
+        # 8 + 16 = 24 tokens each -> 6 blocks each; combined 12 > 7 usable
+        prompts = [rs.randint(0, 64, (8,)).tolist() for _ in range(2)]
+        reqs = [srv.submit(p, max_new_tokens=16) for p in prompts]
+        srv.run()                                # must terminate (guard)
+        assert srv.scheduler.preemption_count > 0, "no thrash exercised"
+        assert all(r.preemptions <= cap for r in reqs)
+        assert srv.scheduler.preemption_count <= cap * len(reqs)
+        assert get_registry().counter(
+            "dstpu_serving_preemptions_total").value - preempt_before \
+            <= cap * len(reqs)
+        for p, r in zip(prompts, reqs):
+            assert r.status is RequestStatus.OK, r.error
+            np.testing.assert_array_equal(np.asarray(r.output),
+                                          _generate(eng, p, 16))
+        assert srv.decode_builds == 1
+        assert srv.allocator.num_used == 0
+
+    def test_run_default_bound_is_finite_and_loud(self):
+        """run(max_steps=None) computes a bound from queued work; a
+        too-small explicit bound raises ServingError carrying queue
+        depth and per-request preemption counts."""
+        eng, srv = serving_engine()
+        rs = np.random.RandomState(61)
+        srv.submit(rs.randint(0, 64, (6,)).tolist(), max_new_tokens=4)
+        srv.submit(rs.randint(0, 64, (9,)).tolist(), max_new_tokens=4)
+        bound = srv._default_max_steps()
+        assert 0 < bound < 10_000
+        with pytest.raises(ServingError, match="did not drain") as exc:
+            srv.run(max_steps=1)
+        assert "preemptions=" in str(exc.value)
+        assert "queue_depth=" in str(exc.value)
+        srv.run()                  # the computed default drains fine
+        assert srv.allocator.num_used == 0
+
+    def test_fully_cached_exact_multiple_resubmission(self):
+        """ISSUE 6 satellite regression: a resubmitted prompt of exactly
+        N full blocks admits with the last block held back (engine
+        samples the first token from a computed position — no
+        output[-1] IndexError) and still streams token-identically."""
+        eng, srv = serving_engine()             # kv_block_size 8
+        rs = np.random.RandomState(67)
+        prompt = rs.randint(0, 64, (16,)).tolist()   # exactly 2 blocks
+        r1 = srv.submit(prompt, max_new_tokens=6)
+        srv.run()
+        r2 = srv.submit(prompt, max_new_tokens=6)
+        srv.run()
+        assert r2.cache_hit_tokens == 8         # last full block held back
+        want = _generate(eng, prompt, 6)
+        np.testing.assert_array_equal(np.asarray(r1.output), want)
+        np.testing.assert_array_equal(np.asarray(r2.output), want)
+        assert r2.status is RequestStatus.OK
+        assert srv.allocator.num_used == 0
+
+
+@pytest.mark.slow
+class TestFaultSites:
+    def test_transient_faults_delay_never_corrupt(self, injector):
+        """Transient faults at every serving site (admission, allocate,
+        append_block, dispatch): requests are delayed — retried
+        admissions, a growth-held iteration, skipped dispatches — but
+        every stream stays token-identical to generate()."""
+        injector.add_plan("serving.admission", "fail", at=2, count=1)
+        injector.add_plan("serving.allocate", "fail", at=2, count=1)
+        injector.add_plan("serving.append_block", "fail", at=2, count=1)
+        injector.add_plan("serving.dispatch", "fail", at=3, count=2)
+        eng, srv = serving_engine()
+        rs = np.random.RandomState(71)
+        prompts = [rs.randint(0, 64, (n,)).tolist() for n in (6, 10, 7)]
+        reqs = [srv.submit(p, max_new_tokens=8) for p in prompts]
+        srv.run()
+        fired = sum(injector.fire_count(s) for s in
+                    ("serving.admission", "serving.allocate",
+                     "serving.append_block", "serving.dispatch"))
+        assert fired >= 3, "fault plans never fired: dead test"
+        for p, r in zip(prompts, reqs):
+            assert r.status is RequestStatus.OK, (r.status, r.error)
+            np.testing.assert_array_equal(np.asarray(r.output),
+                                          _generate(eng, p, 8),
+                                          err_msg=f"prompt {p}")
+        assert srv.decode_builds == 1
+        srv.allocator.assert_consistent()
+        assert srv.allocator.num_used == 0
+
+    def test_fatal_admission_fault_fails_one_request(self, injector):
+        """A fatal fault at admission fails THAT request (terminal
+        FAILED with the cause) and nobody else."""
+        injector.add_plan("serving.admission", "fatal", at=2, count=1)
+        eng, srv = serving_engine(serving={"max_batch_slots": 2})
+        rs = np.random.RandomState(73)
+        prompts = [rs.randint(0, 64, (n,)).tolist() for n in (6, 8, 5)]
+        reqs = [srv.submit(p, max_new_tokens=6) for p in prompts]
+        srv.run()
+        assert reqs[1].status is RequestStatus.FAILED
+        assert "fatal fault at admission" in reqs[1].error
+        assert srv.lifecycle_counts["failed"] == 1
+        for p, r in zip(prompts, reqs):
+            if r is reqs[1]:
+                continue
+            assert r.status is RequestStatus.OK
+            np.testing.assert_array_equal(np.asarray(r.output),
+                                          _generate(eng, p, 6))
+        assert srv.allocator.num_used == 0

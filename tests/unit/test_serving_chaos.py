@@ -1,0 +1,166 @@
+"""Serving chaos suite (ISSUE 6 acceptance): injected faults, cancels,
+deadline expiries, a poisoned slot, and forced KV-pressure preemption
+interleaved over one continuous-batching engine — the drain must end
+with the pool leak-check clean (``assert_consistent`` + zero
+sequence-held blocks), ``decode_builds == 1`` (no retrace, whatever
+failed), and every request that finished ``OK`` streaming
+token-identically to sequential ``generate()``.
+
+Runs standalone AND under the ``run_tests.sh`` serving-chaos stage,
+which replays it across a ``DSTPU_FAULTS`` env matrix (transient-only
+plans on the ``serving.*`` sites): the fixture builds the injector FROM
+the environment, so each matrix entry is the same workload under a
+different fault schedule.  docs/serving.md "Failure handling &
+overload" describes the semantics being pinned.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.inference.serving import RequestState, RequestStatus
+from deepspeed_tpu.models import TransformerLM, gpt2_config
+from deepspeed_tpu.runtime.resilience import (FaultInjector,
+                                              install_fault_injector)
+
+pytestmark = [pytest.mark.inference, pytest.mark.chaos, pytest.mark.slow]
+
+
+@pytest.fixture
+def env_injector():
+    """Install the injector built from DSTPU_FAULTS (empty when unset),
+    so the run_tests.sh fault matrix steers the suite; restored to an
+    empty injector afterwards."""
+    fi = install_fault_injector(FaultInjector.from_env())
+    yield fi
+    install_fault_injector(FaultInjector())
+
+
+def chaos_engine(num_kv_blocks=16, slots=3, max_queue_depth=16):
+    cfg = gpt2_config("125m", num_layers=2, d_model=32, num_heads=4,
+                      vocab_size=64, max_seq_len=64, dtype=jnp.float32)
+    eng = ds.init_inference(TransformerLM(cfg), config={
+        "dtype": "float32", "max_out_tokens": 48, "temperature": 0.0,
+        "replace_with_kernel_inject": False,
+        "serving": {"enabled": True, "kv_block_size": 4,
+                    "num_kv_blocks": num_kv_blocks,
+                    "max_batch_slots": slots,
+                    "prefill_chunk_tokens": 8,
+                    "max_preemptions": 4,
+                    "max_queue_depth": max_queue_depth}})
+    return eng, eng.serving_engine()
+
+
+def _generate(eng, prompt, n):
+    return np.asarray(eng.generate(np.asarray(prompt, np.int32)[None],
+                                   max_new_tokens=n, temperature=0.0))[0]
+
+
+def assert_drained_clean(srv, reqs, finished):
+    """The chaos invariants every scenario must satisfy."""
+    assert len(finished) == len(reqs)
+    assert all(r.status is not None for r in reqs), "in-flight after drain"
+    # the acceptance pin: one compiled program across every failure mode
+    assert srv.decode_builds == 1
+    srv.allocator.assert_consistent()
+    assert srv.allocator.num_used == 0, "sequence-held blocks after drain"
+    assert srv.scheduler.queue_depth == 0
+    assert srv.scheduler.active_slots == 0
+    # lifecycle counters agree with the terminal statuses
+    by = {s: sum(1 for r in reqs if r.status is s) for s in RequestStatus}
+    lc = srv.lifecycle_counts
+    assert lc["cancelled"] == by[RequestStatus.CANCELLED]
+    assert lc["timed_out"] == by[RequestStatus.TIMED_OUT]
+    assert lc["shed"] == by[RequestStatus.SHED]
+    assert lc["failed"] == by[RequestStatus.FAILED]
+    for r in reqs:
+        if r.status is RequestStatus.SHED:
+            assert r.output == [], "shed request must never stream"
+        if r.status is not RequestStatus.OK:
+            assert r in finished
+
+
+def test_chaos_staged_faults_cancels_deadlines(env_injector):
+    """The scripted scenario: staggered waves under KV pressure, one
+    deadline expiry, one mid-flight cancel, one poisoned (NaN) slot —
+    plus whatever DSTPU_FAULTS adds."""
+    eng, srv = chaos_engine()
+    rs = np.random.RandomState(1009)
+    new = 8
+    prompts = [rs.randint(0, 64, (n,)).tolist()
+               for n in (5, 9, 12, 7, 3, 10, 6, 8)]
+    reqs = [srv.submit(p, max_new_tokens=new) for p in prompts[:4]]
+    # deterministic deadline expiry: backdate the clock instead of
+    # racing wall time
+    reqs[3].deadline_s = 1.0
+    reqs[3].submit_time -= 50.0
+    srv.step()
+    srv.step()
+    cancel_target = next((r for r in reqs
+                          if r.state is RequestState.RUNNING
+                          and r.status is None), None)
+    if cancel_target is not None:
+        assert srv.cancel(cancel_target)
+    reqs += [srv.submit(p, max_new_tokens=new) for p in prompts[4:]]
+    srv.step()
+    # poison one healthy decoding slot's first KV block with NaN: the
+    # in-program finite flag must quarantine it (or, if it gets
+    # preempted and its suspect blocks evicted first, it recomputes
+    # clean and must then stream correctly — both outcomes are legal,
+    # corruption of OTHER streams is not)
+    poison = next((r for r in reqs
+                   if r.state is RequestState.RUNNING and r.status is None
+                   and not r.prefilling and len(r.output) < new - 2), None)
+    if poison is not None:
+        blocks = srv.allocator.block_table(poison.req_id)
+        srv._pool_k = srv._pool_k.at[:, blocks[0]].set(jnp.nan)
+    finished = srv.run()
+
+    assert_drained_clean(srv, reqs, finished)
+    assert reqs[3].status is RequestStatus.TIMED_OUT
+    if cancel_target is not None:
+        assert cancel_target.status is RequestStatus.CANCELLED
+    affected = sum(1 for r in reqs if r.status is not RequestStatus.OK)
+    assert affected >= 2, "chaos exercised nothing"
+    assert affected < len(reqs), "no unaffected streams left to check"
+    for p, r in zip(prompts, reqs):
+        if r.status is RequestStatus.OK:
+            np.testing.assert_array_equal(
+                np.asarray(r.output), _generate(eng, p, new),
+                err_msg=f"prompt {p} (status {r.status})")
+
+
+def test_chaos_randomized_interleaving(env_injector):
+    """Randomized (seeded) interleaving of submit / step / cancel /
+    deadline ops over an undersized pool, on top of the env fault
+    schedule: whatever order the chaos lands in, the drain is clean and
+    OK streams are exact."""
+    eng, srv = chaos_engine(num_kv_blocks=14, slots=3, max_queue_depth=6)
+    rs = np.random.RandomState(4242)
+    new = 6
+    reqs, prompts = [], []
+    for i in range(40):
+        op = rs.choice(["submit", "step", "cancel", "step", "submit"])
+        if op == "submit" and len(reqs) < 12:
+            p = rs.randint(0, 64, (int(rs.randint(3, 14)),)).tolist()
+            r = srv.submit(p, max_new_tokens=new)
+            prompts.append(p)
+            reqs.append(r)
+            if rs.random_sample() < 0.2:       # some requests carry a
+                r.deadline_s = 1.0             # TTL that already expired
+                r.submit_time -= 50.0
+        elif op == "cancel" and reqs:
+            srv.cancel(reqs[int(rs.randint(len(reqs)))])
+        else:
+            srv.step()
+    finished = srv.run()
+
+    assert_drained_clean(srv, reqs, finished)
+    assert sum(1 for r in reqs
+               if r.status is RequestStatus.OK) >= 1, "nothing survived"
+    for p, r in zip(prompts, reqs):
+        if r.status is RequestStatus.OK:
+            np.testing.assert_array_equal(
+                np.asarray(r.output), _generate(eng, p, new),
+                err_msg=f"prompt {p}")
