@@ -26,14 +26,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ..parallel.shard_map_compat import shard_map
+
 
 def _mk_collective(name: str, mesh, axis: str) -> Callable:
     n = mesh.shape[axis]
 
     def wrap(body):
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             body, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
-            axis_names={axis}, check_vma=False))
+            axis_names={axis}))
 
     if name == "all_reduce":
         def body(x):

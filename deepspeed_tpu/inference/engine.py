@@ -101,11 +101,14 @@ class InferenceEngine:
         else:
             logger.warning("init_inference without params or checkpoint — "
                            "using fresh random weights")
+            # bound once, called once — never re-wrapped per call (the
+            # TRACE003 discipline; __init__ runs once per engine)
+            init_fn = jax.jit(
+                lambda r: jax.tree_util.tree_map(
+                    self._cast, model.init(r)),
+                out_shardings=shardings)
             with self.mesh:
-                self.params = jax.jit(
-                    lambda r: jax.tree_util.tree_map(
-                        self._cast, model.init(r)),
-                    out_shardings=shardings)(jax.random.PRNGKey(0))
+                self.params = init_fn(jax.random.PRNGKey(0))
 
         # -- int8 weight-only serving (reference GroupQuantizer at
         # module_inject/replace_module.py:150: qkv/mlp weights stored int8,
@@ -212,10 +215,12 @@ class InferenceEngine:
                 return jax.vmap(lambda w: qz_one(w, f, l.shape[1:]))(l)
             return qz_one(l, f, l.shape)
 
+        # bound once, called once per quantization pass (TRACE003)
+        qz_fn = jax.jit(lambda p: jax.tree_util.tree_map_with_path(
+            qz, p, self._qflags,
+            is_leaf=lambda x: isinstance(x, jax.Array)))
         with self.mesh:
-            pairs = jax.jit(lambda p: jax.tree_util.tree_map_with_path(
-                qz, p, self._qflags,
-                is_leaf=lambda x: isinstance(x, jax.Array)))(self.params)
+            pairs = qz_fn(self.params)
         tup = lambda t: isinstance(t, tuple)  # noqa: E731
         self.params = jax.tree_util.tree_map(lambda t: t[0], pairs,
                                              is_leaf=tup)

@@ -91,6 +91,13 @@ def _kernel_heads(len_ref, q_ref, k_ref, v_ref, o_ref,
         l_scr[...] = alpha * l_scr[...] + jnp.sum(p, axis=0,
                                                   keepdims=True)
         v = v_ref[...].astype(jnp.float32)            # [chunk, H, D]
+        # masked rows get probability ~0, but 0 * NaN = NaN: zero the v
+        # rows past the valid length — the ragged tail chunk reads past
+        # the cache's end (no jnp.pad copy), and Pallas deliberately
+        # poisons out-of-bounds rows in interpret mode, so any masked
+        # row must tolerate ANY content (same convention as the paged
+        # kernels since the PR 6 quarantine-block leak)
+        v = jnp.where((pos < len_ref[0])[..., None], v, 0.0)
         pv = jnp.sum(p[:, :, None] * v, axis=0)       # [H, D]
         acc_scr[...] = _rowscale(alpha, acc_scr[...]) + pv
         m_scr[...] = m_new
@@ -125,19 +132,18 @@ def decode_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         interpret = _interpret_default()
 
     # chunk: contiguous rows per DMA slab, scaled so slab bytes stay
-    # constant as H*D varies, then rounded DOWN to a power of two so the
-    # usual power-of-two cache lengths divide exactly — a non-dividing
-    # chunk would jnp.pad (full-copy!) the entire cache every step
+    # constant as H*D varies, then rounded DOWN to a power of two (DMA-
+    # friendly; the usual power-of-two cache lengths divide exactly).
+    # A non-dividing length needs NO jnp.pad full-cache copy: the grid
+    # ceil-divides and the tail chunk simply reads past the cache's end
+    # — those rows sit at pos >= length, which the kernel masks out of
+    # the scores AND zeroes out of v (dstpu-lint PALLAS004 pins that
+    # the pad never comes back)
     chunk = max(8, min(1024, _CHUNK_ELEMS // (h * d)))
     chunk = 1 << (chunk.bit_length() - 1)
     if s < chunk:
-        chunk = max(8, s)      # single-slab case: pad cost is one slab
-    if s % chunk:
-        pad = chunk - s % chunk
-        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
-        s = s + pad
-    nc = s // chunk
+        chunk = max(8, s)      # single-slab case
+    nc = -(-s // chunk)
     length = jnp.asarray(length, jnp.int32).reshape(1)
 
     out = pl.pallas_call(

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ...parallel.topology import SEQUENCE_AXIS
+from ...parallel.shard_map_compat import shard_map
 
 MASK_VALUE = -1e30
 
@@ -111,6 +112,6 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         return out.astype(ql.dtype)
 
     spec = P(None, axis, None, None)
-    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, axis_names={axis}, check_vma=False)
+    fn = shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, axis_names={axis})
     return fn(q, k, v)

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ...parallel.topology import SEQUENCE_AXIS
+from ...parallel.shard_map_compat import shard_map
 
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -78,6 +79,6 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                                   tiled=True)
 
     spec = P(None, axis, None, None)
-    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, axis_names={axis}, check_vma=False)
+    fn = shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, axis_names={axis})
     return fn(q, k, v)

@@ -60,7 +60,6 @@ COMPRESSION_TRAINING = "compression_training"
 DATA_EFFICIENCY = "data_efficiency"
 CURRICULUM_LEARNING_LEGACY = "curriculum_learning"
 PIPELINE = "pipeline"
-MOE = "moe"
 SEQUENCE_PARALLEL = "sequence_parallel"
 MESH = "mesh"
 CHECKPOINT = "checkpoint"
@@ -144,7 +143,9 @@ SERVING_NO_PROGRESS_STEPS_DEFAULT = 64
 # overrides per request.
 SERVING_DEFAULT_DEADLINE_S_DEFAULT = 0.0
 
-ROUTE_TRAIN = "train"
-ROUTE_EVAL = "eval"
-ROUTE_PREDICT = "predict"
-ROUTE_ENCODE = "encode"
+# The reference's inference-route keys (ROUTE_TRAIN/EVAL/PREDICT/ENCODE)
+# and a top-level MOE block key were carried here for five PRs without a
+# consumer — keys nobody reads are schema lies users trip over, so they
+# were DELETED (dstpu-lint CFG001) rather than grandfathered.  MoE
+# configuration lives in the model config; routes are not part of this
+# repo's inference API.

@@ -27,6 +27,7 @@ device buffer); ``train_batch()``/``train_step()`` is the native path.
 """
 from __future__ import annotations
 
+import math
 import time
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
@@ -37,12 +38,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..observability import get_registry, trace_span
 from ..parallel import topology as topo
+from ..parallel.shard_map_compat import shard_map
 from ..utils.logging import logger
 from . import lr_schedules
 from .config import DeepSpeedConfig
 from .fp16 import DynamicLossScaler, static_loss_scaler
 from .optimizers import Optimizer, get_optimizer, wrap_optax
 from .resilience import Heartbeat
+from .utils import host_transfer
 from .zero.sharding import ZeroShardingPolicy, constrain, to_named
 
 MEM_EFFICIENT_LINEAR_DEFAULT = True
@@ -261,6 +264,17 @@ class DeepSpeedEngine:
     def state_shardings(self) -> Dict:
         return to_named(self.mesh, self.state_specs())
 
+    def _cached_program(self, key: str, build: Callable):
+        """Engine-lifetime cache for jitted programs (the TRACE003
+        discipline: never construct ``jax.jit(...)`` per call — the
+        compile cache is keyed on the callable object, so a fresh wrap
+        retraces every time).  ``build`` runs once per ``key``."""
+        if not hasattr(self, "_programs_misc"):
+            self._programs_misc = {}
+        if key not in self._programs_misc:
+            self._programs_misc[key] = build()
+        return self._programs_misc[key]
+
     def init_state(self, rng) -> Dict:
         """Build the train state directly into its target shardings — the
         jitted init materializes only each device's shard (replaces the
@@ -288,9 +302,11 @@ class DeepSpeedEngine:
                 state["scaler"] = self.loss_scaler.init()
             return state
 
+        init_fn = self._cached_program(
+            "init_state",
+            lambda: jax.jit(_init, out_shardings=self.state_shardings()))
         with self.mesh:
-            return jax.jit(_init,
-                           out_shardings=self.state_shardings())(rng)
+            return init_fn(rng)
 
     def _init_state_offload(self, rng) -> Dict:
         """Offload init: fp32 params materialize sharded on device, move to
@@ -298,9 +314,11 @@ class DeepSpeedEngine:
         copy in the model shardings."""
         from .zero.offload import HostLossScaler, ZeroOffloadHostOptimizer
         f32_shardings = to_named(self.mesh, self.master_specs)
+        init_fn = self._cached_program(
+            "init_offload_f32",
+            lambda: jax.jit(self.model.init, out_shardings=f32_shardings))
         with self.mesh:
-            f32_params = jax.jit(self.model.init,
-                                 out_shardings=f32_shardings)(rng)
+            f32_params = init_fn(rng)
         host_tree = jax.device_get(f32_params)
         self._host_opt = ZeroOffloadHostOptimizer(self, host_tree)
         if self.loss_scaler is not None:
@@ -456,33 +474,40 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps
         scale = self._host_scaler.scale if self._host_scaler else 1.0
         wcb = cfg.wall_clock_breakdown
+        step_i = int(self.state["step"])
         if wcb:
             self.timers("offload/grads").start()
         with trace_span("offload/grads", gas=gas):
             lsum, grads, gnorm_raw = self._offload_grad_fn(
                 self.state, batch, jnp.asarray(scale, jnp.float32),
-                jax.random.PRNGKey(int(self.state["step"])))
+                jax.random.PRNGKey(step_i))
 
+        # the host sweep needs loss/gnorm/lr on the host anyway — this
+        # IS the step's sync boundary, so move all three over in ONE
+        # batched host_transfer instead of three scattered float()
+        # round trips (each a full device round trip on its own)
+        stats = jnp.stack([lsum, gnorm_raw,
+                           self.lr_schedule(jnp.asarray(step_i))])
+        lsum_h, gnorm_h, lr_h = host_transfer(stats)
         denom = scale * gas
-        gnorm = float(gnorm_raw) / denom
+        gnorm = float(gnorm_h) / denom
+        lr = float(lr_h)
         if wcb:
-            self.timers("offload/grads").stop()  # the float above synced
+            self.timers("offload/grads").stop()  # the transfer synced
         # a non-finite norm skips the host sweep either because the fp16
         # scaler says so or because resilience hygiene does (bf16 offload
         # runs have no scaler but the same poisoned-masters failure mode)
-        overflow = (not np.isfinite(gnorm)) and \
+        overflow = (not math.isfinite(gnorm)) and \
             ((self._host_scaler is not None
               and self._host_scaler.detect_overflow)
              or cfg.resilience.skip_nonfinite_grad_steps)
-        step_i = int(self.state["step"])
         if overflow:
             self.state["skipped"] = self.state["skipped"] + 1
         else:
             factor = 1.0
             if cfg.gradient_clipping and cfg.gradient_clipping > 0 \
-                    and np.isfinite(gnorm):
+                    and math.isfinite(gnorm):
                 factor = min(1.0, cfg.gradient_clipping / max(gnorm, 1e-6))
-            lr = float(self.lr_schedule(jnp.asarray(step_i)))
             # overlapped sweep: bucket i+1 D2H || bucket i native Adam ||
             # bucket i-1 H2D (reference PipelinedOptimizerSwapper:55)
             fetch_fn = None
@@ -516,7 +541,7 @@ class DeepSpeedEngine:
                 if wcb:
                     self.timers("offload/sweep").stop()
                 flat = np.concatenate(
-                    [np.asarray(o).reshape(-1) for o in outs])
+                    [host_transfer(o).reshape(-1) for o in outs])
                 if up_dtype is not None:
                     flat = flat.astype(up_dtype)
                 with trace_span("offload/upload"):
@@ -539,9 +564,9 @@ class DeepSpeedEngine:
             self._host_scaler.update(overflow)
 
         metrics = {
-            "loss": float(lsum) / denom,
+            "loss": float(lsum_h) / denom,
             "grad_norm": gnorm,
-            "lr": float(self.lr_schedule(jnp.asarray(step_i))),
+            "lr": lr,
             "overflow": int(overflow),
             "loss_scale": scale,
         }
@@ -692,9 +717,12 @@ class DeepSpeedEngine:
         if getattr(self, "_onebit_errors", None) is None:
             def espec(leaf):
                 return P(axis, *([None] * (leaf.ndim - 1)))
+            err_init = self._cached_program(
+                "onebit_init_errors",
+                lambda: jax.jit(
+                    lambda: opt.init_errors(self._param_shapes, w)))
             with self.mesh:
-                errs = jax.jit(
-                    lambda: opt.init_errors(self._param_shapes, w))()
+                errs = err_init()
             shardings = jax.tree_util.tree_map(
                 lambda l: NamedSharding(self.mesh, espec(l)), errs)
             self._onebit_errors = jax.device_put(errs, shardings)
@@ -767,7 +795,7 @@ class DeepSpeedEngine:
             def step_fn(state, errors, batch):
                 bspec = jax.tree_util.tree_map(lambda _: P(None, axis),
                                                batch)
-                sharded = jax.shard_map(
+                sharded = shard_map(
                     core, mesh=self.mesh,
                     in_specs=(state_specs, err_in, bspec),
                     out_specs=(state_specs, err_in,
@@ -775,7 +803,7 @@ class DeepSpeedEngine:
                                    lambda _: P(),
                                    {"loss": 0, "grad_norm": 0, "lr": 0,
                                     "overflow": 0, "loss_scale": 0})),
-                    axis_names={axis}, check_vma=False)
+                    axis_names={axis})
                 return sharded(state, errors, batch)
 
             with self.mesh:
@@ -787,10 +815,13 @@ class DeepSpeedEngine:
         # (reference reinitial_error_buffer, zoadam.py:324)
         if key in getattr(opt, "reset_errors_on", ()) and \
                 not getattr(self, "_onebit_errors_reset", False):
-            with self.mesh:
-                self._onebit_errors = jax.jit(
+            zero_fn = self._cached_program(
+                "onebit_zero_errors",
+                lambda: jax.jit(
                     lambda e: jax.tree_util.tree_map(jnp.zeros_like, e),
-                    donate_argnums=(0,))(self._onebit_errors)
+                    donate_argnums=(0,)))
+            with self.mesh:
+                self._onebit_errors = zero_fn(self._onebit_errors)
             self._onebit_errors_reset = True
 
         compiled = self._onebit_compiled[key]
@@ -818,12 +849,17 @@ class DeepSpeedEngine:
         local_b = global_b // nproc if nproc > 1 else global_b
 
         def prep(k, x):
-            x = np.asarray(x)
+            # deliberate host materialization: batches normally arrive
+            # as host arrays (train_step only calls shard_batch when the
+            # leaves are NOT jax.Array), so this is a coercion, not a
+            # device round trip — and when a caller DOES hand a device
+            # leaf, the sync is the documented contract of this helper
+            x = host_transfer(x)
             if k == "moe_rng":
                 # a single PRNG key: split into one key per microbatch so
                 # gate randomness (RTS / RSample) differs across the GAS scan
                 if x.shape == (2,):
-                    x = np.asarray(jax.random.split(
+                    x = host_transfer(jax.random.split(
                         jnp.asarray(x, jnp.uint32), gas))
                 if x.shape != (gas, 2):
                     raise ValueError(
@@ -932,7 +968,7 @@ class DeepSpeedEngine:
         else:
             self.tput_timer.stop()
         if self._config.wall_clock_breakdown:
-            jax.block_until_ready(metrics["loss"])
+            host_transfer(metrics["loss"], block=True)
             self._step_times.append(time.perf_counter() - t0)
         # keep get_global_grad_norm() current: the compat step() path and
         # the offload/infinity paths set this too

@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 from ...models import layers as L
 from ...observability import trace_span
 from ...parallel import topology as topo
+from ...parallel.shard_map_compat import shard_map
 from ..engine import DeepSpeedEngine, _count_jit_build, global_norm
 from ..zero.sharding import constrain
 
@@ -503,11 +504,11 @@ class PipelineEngine(DeepSpeedEngine):
     def _build_1f1b_train_step(self):
         pipe_specs = self.adapter.pipe_specs()
         grad_out_specs = pipe_specs   # same tree/layout as the params
-        sharded = jax.shard_map(
+        sharded = shard_map(
             self._pipeline_value_and_grad, mesh=self.mesh,
             in_specs=(pipe_specs, P(), P()),
             out_specs=(P(), grad_out_specs),
-            axis_names={topo.PIPE_AXIS}, check_vma=False)
+            axis_names={topo.PIPE_AXIS})
         n_micro = float(self.micro_batches)
 
         def step_fn(state, batch):
@@ -539,10 +540,10 @@ class PipelineEngine(DeepSpeedEngine):
         auto_axes = frozenset(a for a in self.mesh.axis_names
                               if a != topo.PIPE_AXIS)
         pipe_specs = self.adapter.pipe_specs()
-        sharded_loss = jax.shard_map(
+        sharded_loss = shard_map(
             self._pipeline_loss, mesh=self.mesh,
             in_specs=(pipe_specs, P()), out_specs=P(),
-            axis_names={topo.PIPE_AXIS}, check_vma=False)
+            axis_names={topo.PIPE_AXIS})
 
         def step_fn(state, batch):
             ids = batch["input_ids"]        # [M, mb, T]

@@ -9,11 +9,22 @@ detects those hazard classes at lint time over the package's own source
 (stdlib ``ast`` only, no third-party deps) — see ``docs/lint.md`` for
 the rule catalog.
 
-Rule families:
-  SYNC  — host-sync hazards reachable from jit/step hot paths
-  TRACE — retrace / tracer-leak hazards inside jitted functions
-  LOCK  — threaded shared-state and lock-discipline hazards
-  CFG   — config-schema consistency (+ pytest-marker registration)
+Rule families (all sharing ONE parse + ONE symbol-table walk per
+module; see ``core.get_symtab``):
+  SYNC   — host-sync hazards reachable from jit/step hot paths
+  TRACE  — retrace / tracer-leak hazards inside jitted functions
+  LOCK   — threaded shared-state and lock-discipline hazards
+  CFG    — config-schema consistency (+ pytest-marker registration)
+  PALLAS — Pallas-kernel hazards (CompilerParams bypass, 0*NaN
+           select-by-multiply, non-f32 accumulators, wrapper pads,
+           impure index_maps)
+  MESH   — mesh/sharding discipline (explicit specs, declared axis
+           names, Mesh construction, shard_map compat spelling)
+  LIFE   — resource lifecycle (allocator alloc/free pairing, terminal
+           RequestStatus stamping, fault-site catalog)
+
+Findings can be exported as SARIF 2.1.0 (``--sarif``) for inline CI
+annotation; severity tiers filter via ``--min-severity``.
 
 Entry points: ``bin/dstpu-lint`` is the dependency-free CLI (it loads
 this package by path, skipping the jax import in the package root);
@@ -23,4 +34,5 @@ use the bin/ form in CI and jax-less environments.
 """
 from .core import Finding, Severity, lint_paths  # noqa: F401
 from .baseline import Baseline  # noqa: F401
+from .sarif import to_sarif, write_sarif  # noqa: F401
 from .cli import main  # noqa: F401

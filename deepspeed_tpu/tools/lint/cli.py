@@ -15,7 +15,8 @@ from typing import List, Optional
 from .baseline import Baseline
 from .core import Finding, lint_paths
 
-FAMILIES = ("SYNC", "TRACE", "LOCK", "CFG", "TEST")
+FAMILIES = ("SYNC", "TRACE", "LOCK", "CFG", "TEST", "PALLAS", "MESH",
+            "LIFE")
 
 RULE_CATALOG = {
     "SYNC001": "`.item()` device→host sync in a hot path",
@@ -34,6 +35,23 @@ RULE_CATALOG = {
     "CFG002": "*_DEFAULT constant consumed nowhere",
     "CFG003": "raw string config key not declared in constants.py",
     "TEST001": "pytest marker not registered in pytest.ini",
+    "PALLAS001": "direct pltpu.CompilerParams construction bypassing "
+                 "pallas_compat.compiler_params()",
+    "PALLAS002": "select-by-multiply on a mask in a kernel (0*NaN "
+                 "poison) — use jnp.where(mask, v, 0)",
+    "PALLAS003": "non-f32 scratch accumulator in a pallas_call kernel",
+    "PALLAS004": "jnp.pad inside a pallas_call wrapper",
+    "PALLAS005": "BlockSpec index_map reads mutable state / calls host "
+                 "functions",
+    "MESH001": "shard_map/pjit without explicit in_specs/out_specs",
+    "MESH002": "collective over an axis name topology.py does not "
+               "declare",
+    "MESH003": "Mesh(...) constructed outside parallel/topology.py",
+    "MESH004": "jax.shard_map spelling bypassing "
+               "parallel/shard_map_compat",
+    "LIFE001": "allocator allocate/fork with no reachable free",
+    "LIFE002": "terminal RequestStatus stamped outside _terminalize()",
+    "LIFE003": "FaultInjector site missing from the documented catalog",
 }
 
 
@@ -68,6 +86,13 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rules", default=None,
                    help="comma-separated rule/family prefixes to keep "
                         "(e.g. SYNC,LOCK001)")
+    p.add_argument("--min-severity", default=None,
+                   choices=("info", "warning", "error"),
+                   help="drop findings below this severity tier")
+    p.add_argument("--sarif", default=None, metavar="PATH",
+                   help="also write the findings as SARIF 2.1.0 "
+                        "(baselined findings marked suppressed) — the "
+                        "CI artifact forges annotate diffs from")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--quiet", "-q", action="store_true",
                    help="suppress the grandfathered-finding lines "
@@ -152,7 +177,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             paths, root=root, rules=rules,
             check_markers=args.check_markers,
             tests_dir=args.tests_dir, pytest_ini=args.pytest_ini,
-            errors=errors)
+            errors=errors, min_severity=args.min_severity)
     except RecursionError as e:  # pragma: no cover - pathological input
         print(f"dstpu-lint: internal error: {e}", file=sys.stderr)
         return 2
@@ -180,6 +205,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"dstpu-lint: {e}", file=sys.stderr)
             return 2
         new, old = bl.split(findings)
+
+    if args.sarif:
+        from .sarif import write_sarif
+        write_sarif(args.sarif, new, old, RULE_CATALOG)
 
     if args.format == "json":
         print(json.dumps({

@@ -135,6 +135,198 @@ class Project:
         return None
 
 
+# ---------------------------------------------------------------------------
+# shared symbol table — ONE walk per module, consumed by every family
+# ---------------------------------------------------------------------------
+class ModuleIndex:
+    """Per-module import tables: alias -> dotted module, and
+    from-imported name -> (module, attr)."""
+
+    def __init__(self, mod: SourceModule):
+        self.mod = mod
+        self.import_modules: Dict[str, str] = {}
+        self.from_imports: Dict[str, tuple] = {}
+        self._scan_imports()
+
+    def _resolve_relative(self, level: int, name: Optional[str]) -> str:
+        if not level:
+            # absolute import: the dotted module IS the source (the
+            # hotpath-era code prefixed the current module's path here,
+            # so ``from jax.experimental.shard_map import shard_map``
+            # never resolved — fixed with the PR 7 symbol table)
+            return name or ""
+        parts = self.mod.modname.split(".")
+        # a module's package is its parent; level=1 is that package
+        base = parts[: len(parts) - level]
+        if name:
+            base = base + name.split(".")
+        return ".".join(base)
+
+    def _scan_imports(self) -> None:
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_modules[a.asname or a.name.split(".")[0]] = \
+                        a.name
+            elif isinstance(node, ast.ImportFrom):
+                src = self._resolve_relative(node.level, node.module)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    # ``from . import wire_codec`` imports a MODULE;
+                    # ``from .retry import retry_call`` imports a name —
+                    # record both, the resolver tries module first
+                    self.import_modules.setdefault(
+                        a.asname or a.name, f"{src}.{a.name}")
+                    self.from_imports[a.asname or a.name] = (src, a.name)
+
+
+def src_of(node: ast.AST, limit: int = 48) -> str:
+    """Truncated source text of a node for finding messages."""
+    try:
+        s = ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse failures
+        s = "<expr>"
+    return s if len(s) <= limit else s[: limit - 3] + "..."
+
+
+def callee_name(call: ast.Call) -> str:
+    """Final name of a call target: ``f`` for ``f(...)``, ``m`` for
+    ``a.b.m(...)``, "" otherwise."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def annotate_parents(tree: ast.AST) -> None:
+    """Attach ``_dstpu_parent`` to every node (idempotent; the symbol
+    table applies it once per module so no family re-annotates)."""
+    if getattr(tree, "_dstpu_parented", False):
+        return
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._dstpu_parent = node  # type: ignore[attr-defined]
+    tree._dstpu_parented = True  # type: ignore[attr-defined]
+
+
+def enclosing_scope(node: ast.AST) -> str:
+    """Dotted qualname of the function/class scope holding ``node``
+    (walks the parent annotation; "" at module level)."""
+    parts: List[str] = []
+    cur = getattr(node, "_dstpu_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            parts.append(cur.name)
+        cur = getattr(cur, "_dstpu_parent", None)
+    return ".".join(reversed(parts))
+
+
+def enclosing_function(node: ast.AST):
+    """Nearest enclosing FunctionDef/AsyncFunctionDef (None at module
+    level)."""
+    cur = getattr(node, "_dstpu_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = getattr(cur, "_dstpu_parent", None)
+    return None
+
+
+class SymbolTable:
+    """Project-wide lookaside built in ONE ``ast.walk`` per module.
+
+    Before PR 7 every rule family re-walked every tree (SYNC/TRACE
+    shared the hot-path walk, but LOCK, CFG and any new family each
+    paid their own full traversal + parent annotation + import scan).
+    Now the walk happens once; families consume these tables:
+
+      * ``index(mod)``      — import tables (alias/from-import maps)
+      * ``calls``           — every ``ast.Call`` per module
+      * ``classes``         — every ``ast.ClassDef`` per module
+      * ``functions``       — every function def per module
+      * ``attr_names`` / ``name_ids`` — identifier-usage sets (CFG)
+      * ``str_args``        — string literals appearing as call args
+
+    Parent links (``_dstpu_parent``) are applied here, so
+    ``enclosing_scope``/``enclosing_function`` work on any node.
+    """
+
+    def __init__(self, project: Project):
+        self.project = project
+        self._indexes: Dict[str, ModuleIndex] = {}
+        self.calls: Dict[str, List[ast.Call]] = {}
+        self.classes: Dict[str, List[ast.ClassDef]] = {}
+        self.functions: Dict[str, List[ast.AST]] = {}
+        self.attributes: Dict[str, List[ast.Attribute]] = {}
+        self.attr_names: Dict[str, Set[str]] = {}
+        self.name_ids: Dict[str, Set[str]] = {}
+        for mod in project.modules:
+            annotate_parents(mod.tree)
+            self._indexes[mod.modname] = ModuleIndex(mod)
+            calls: List[ast.Call] = []
+            classes: List[ast.ClassDef] = []
+            funcs: List[ast.AST] = []
+            attr_nodes: List[ast.Attribute] = []
+            attrs: Set[str] = set()
+            names: Set[str] = set()
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    calls.append(node)
+                elif isinstance(node, ast.ClassDef):
+                    classes.append(node)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    funcs.append(node)
+                elif isinstance(node, ast.Attribute):
+                    attr_nodes.append(node)
+                    attrs.add(node.attr)
+                elif isinstance(node, ast.Name):
+                    names.add(node.id)
+            self.calls[mod.rel] = calls
+            self.classes[mod.rel] = classes
+            self.functions[mod.rel] = funcs
+            self.attributes[mod.rel] = attr_nodes
+            self.attr_names[mod.rel] = attrs
+            self.name_ids[mod.rel] = names
+
+    def index(self, mod: SourceModule) -> ModuleIndex:
+        return self._indexes[mod.modname]
+
+    def identifiers_used(self, skip_rel: str) -> Set[str]:
+        """Every attribute/name identifier used anywhere but
+        ``skip_rel`` (the CFG consumption check)."""
+        used: Set[str] = set()
+        for mod in self.project.modules:
+            if mod.rel == skip_rel:
+                continue
+            used |= self.attr_names[mod.rel]
+            used |= self.name_ids[mod.rel]
+        return used
+
+    def dotted(self, node: ast.AST) -> str:
+        """Best-effort dotted name of an expression ('np.random.rand')."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+        return ".".join(reversed(parts))
+
+
+def get_symtab(project: Project) -> SymbolTable:
+    """Cached symbol table — every family shares one build."""
+    cached = getattr(project, "_symtab", None)
+    if cached is None:
+        cached = SymbolTable(project)
+        project._symtab = cached  # type: ignore[attr-defined]
+    return cached
+
+
 _SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "build", "dist",
               "node_modules", ".venv", "venv"}
 
@@ -169,28 +361,47 @@ def load_project(paths: Sequence[str], root: Optional[str] = None,
     return Project(root=root, modules=modules)
 
 
+def all_families():
+    """(name, run-callable) per rule family — single source for
+    ``lint_paths`` AND the per-family-equivalence pin in the tests."""
+    from . import (rules_sync, rules_trace, rules_lock, rules_config,
+                   rules_pallas, rules_mesh, rules_life)
+    return [("SYNC", rules_sync.run), ("TRACE", rules_trace.run),
+            ("LOCK", rules_lock.run), ("CFG", rules_config.run),
+            ("PALLAS", rules_pallas.run), ("MESH", rules_mesh.run),
+            ("LIFE", rules_life.run)]
+
+
 def lint_paths(paths: Sequence[str], root: Optional[str] = None,
                rules: Optional[Iterable[str]] = None,
                check_markers: bool = False,
                tests_dir: Optional[str] = None,
                pytest_ini: Optional[str] = None,
-               errors: Optional[List[str]] = None) -> List[Finding]:
+               errors: Optional[List[str]] = None,
+               min_severity: Optional[str] = None) -> List[Finding]:
     """Run every rule family over ``paths``; returns suppressed-filtered
     findings sorted by (path, line, rule). ``rules`` limits to rule-id /
-    family prefixes (e.g. ``{"SYNC", "LOCK001"}``)."""
-    from . import rules_sync, rules_trace, rules_lock, rules_config
+    family prefixes (e.g. ``{"SYNC", "LOCK001"}``); ``min_severity``
+    drops findings below a tier (``info`` < ``warning`` < ``error``).
+
+    All families share ONE parse and ONE symbol-table walk per module
+    (``get_symtab``); the hot-path call graph is likewise built once
+    (``hotpath.get_hot``)."""
+    from . import rules_config
     project = load_project(paths, root=root, errors=errors)
     findings: List[Finding] = []
-    findings += rules_sync.run(project)
-    findings += rules_trace.run(project)
-    findings += rules_lock.run(project)
-    findings += rules_config.run(project)
+    for _name, run in all_families():
+        findings += run(project)
     if check_markers:
         findings += rules_config.check_pytest_markers(
             project.root, tests_dir=tests_dir, pytest_ini=pytest_ini)
     if rules:
         pref = tuple(rules)
         findings = [f for f in findings if f.rule.startswith(pref)]
+    if min_severity:
+        order = {Severity.INFO: 0, Severity.WARNING: 1, Severity.ERROR: 2}
+        floor = order[min_severity]
+        findings = [f for f in findings if order[f.severity] >= floor]
     by_rel = {m.rel: m for m in project.modules}
     findings = [f for f in findings
                 if f.path not in by_rel or not by_rel[f.path].suppressed(f)]

@@ -24,7 +24,7 @@ import ast
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
-from .core import Project, SourceModule
+from .core import ModuleIndex, Project, SourceModule, get_symtab
 
 #: function names treated as per-step hot-path roots even without jit
 STEP_ROOT_NAMES = {
@@ -92,44 +92,9 @@ def iter_own_nodes(func_node: ast.AST):
 
 
 # ---------------------------------------------------------------------------
-# import / name resolution
+# import / name resolution — ModuleIndex moved to core.py (PR 7): the
+# shared symbol table owns the one-per-module import scan now
 # ---------------------------------------------------------------------------
-class ModuleIndex:
-    """Per-module import tables + function registry."""
-
-    def __init__(self, mod: SourceModule):
-        self.mod = mod
-        self.import_modules: Dict[str, str] = {}    # alias -> dotted module
-        self.from_imports: Dict[str, Tuple[str, str]] = {}  # n -> (mod, attr)
-        self._scan_imports()
-
-    def _resolve_relative(self, level: int, name: Optional[str]) -> str:
-        parts = self.mod.modname.split(".")
-        # a module's package is its parent; level=1 is that package
-        base = parts[: len(parts) - level] if level else parts
-        if name:
-            base = base + name.split(".")
-        return ".".join(base)
-
-    def _scan_imports(self) -> None:
-        for node in ast.walk(self.mod.tree):
-            if isinstance(node, ast.Import):
-                for a in node.names:
-                    self.import_modules[a.asname or a.name.split(".")[0]] = \
-                        a.name
-            elif isinstance(node, ast.ImportFrom):
-                src = self._resolve_relative(node.level, node.module)
-                for a in node.names:
-                    if a.name == "*":
-                        continue
-                    # ``from . import wire_codec`` imports a MODULE;
-                    # ``from .retry import retry_call`` imports a name —
-                    # record both, the resolver tries module first
-                    self.import_modules.setdefault(
-                        a.asname or a.name, f"{src}.{a.name}")
-                    self.from_imports[a.asname or a.name] = (src, a.name)
-
-
 def _is_jit_expr(node: ast.AST, idx: ModuleIndex) -> bool:
     """``jax.jit`` / ``jit`` / ``pjit`` (by import or attribute)."""
     if isinstance(node, ast.Attribute) and node.attr in ("jit", "pjit"):
@@ -307,12 +272,6 @@ class _Collector(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def _annotate_parents(tree: ast.AST) -> None:
-    for node in ast.walk(tree):
-        for child in ast.iter_child_nodes(node):
-            child._dstpu_parent = node  # type: ignore[attr-defined]
-
-
 # ---------------------------------------------------------------------------
 # public entry
 # ---------------------------------------------------------------------------
@@ -326,12 +285,11 @@ def get_hot(project: Project) -> HotInfo:
 
 
 def analyze(project: Project) -> HotInfo:
+    symtab = get_symtab(project)   # parents + import tables, built once
     funcs: Dict[FuncKey, FuncInfo] = {}
     wraps: List[JitWrap] = []
     for mod in project.modules:
-        _annotate_parents(mod.tree)
-        idx = ModuleIndex(mod)
-        _Collector(mod, idx, funcs, wraps).visit(mod.tree)
+        _Collector(mod, symtab.index(mod), funcs, wraps).visit(mod.tree)
     # lambdas registered during the walk may be jit targets recorded
     # before resolution; mark any wrap target that exists now
     for w in wraps:

@@ -50,17 +50,9 @@ def _collect_constants(tree: ast.Module) -> Dict[str, Tuple[object, int]]:
 def _identifier_usage(project: Project, skip_rel: str) -> Set[str]:
     """Every attribute/name identifier used anywhere but ``skip_rel`` —
     the cheap global consumption check (C.NAME and from-imported NAME
-    both land here)."""
-    used: Set[str] = set()
-    for mod in project.modules:
-        if mod.rel == skip_rel:
-            continue
-        for node in ast.walk(mod.tree):
-            if isinstance(node, ast.Attribute):
-                used.add(node.attr)
-            elif isinstance(node, ast.Name):
-                used.add(node.id)
-    return used
+    both land here), served from the shared symbol table."""
+    from .core import get_symtab
+    return get_symtab(project).identifiers_used(skip_rel)
 
 
 def _raw_key_calls(tree: ast.Module) -> List[Tuple[str, ast.AST]]:

@@ -1,0 +1,236 @@
+"""LIFE — resource-lifecycle discipline in the serving stack.
+
+The PR 6 failure classes were lifecycle-shaped: a leaked block table
+starves the pool, a terminal status stamped off the scheduler's single
+path double-counts lifecycle metrics and skips the free, and a fault-
+injection site nobody documented is a failure path nobody sweeps.  All
+three are mechanically visible in the AST:
+
+  LIFE001  allocator ``allocate``/``fork`` call in a class (or module
+           scope) that never calls ``free`` on the same receiver — the
+           alloc has no path to the pool's refcount decrement.
+           Receivers are recognized by the allocator convention: the
+           receiver's final name contains ``alloc``, or it was
+           constructed from a ``*Allocator`` class.
+  LIFE002  terminal ``RequestStatus`` assigned outside the scheduler's
+           ``_terminalize`` — the single stamp point is what makes
+           terminal states exactly-once (cancel/timeout/quarantine all
+           funnel through it)
+  LIFE003  ``FaultInjector`` site id used in code but absent from the
+           documented site catalog (``docs/resilience.md``) — an
+           undocumented site is a failure path the chaos matrix never
+           sweeps
+
+LIFE003 reads the catalog as the set of backtick-quoted tokens in
+``docs/resilience.md``; when the doc is absent the rule stays silent.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (Finding, Project, Severity, SourceModule,
+                   enclosing_function, enclosing_scope, get_symtab,
+                   src_of as _src)
+
+_ALLOC_METHODS = {"allocate", "fork"}
+_FREE_METHODS = {"free"}
+TERMINALIZE = "_terminalize"
+SITE_DOC = os.path.join("docs", "resilience.md")
+
+#: backticked site-shaped tokens only (``a.b``) — a greedy pairing
+#: would span code fences and swallow whole paragraphs
+_BACKTICK_RE = re.compile(r"`([A-Za-z0-9_][A-Za-z0-9_.]*)`")
+
+
+def _recv_key(node: ast.AST) -> Optional[str]:
+    """Stable receiver identity for ``<recv>.allocate(...)`` — the
+    dotted source of the receiver expression."""
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover
+        return None
+
+
+def _alloc_like(recv_key: str, ctor_names: Set[str]) -> bool:
+    last = recv_key.split(".")[-1]
+    return "alloc" in last.lower() or recv_key in ctor_names
+
+
+# ---------------------------------------------------------------------------
+# LIFE001 — allocate/fork without a reachable free
+# ---------------------------------------------------------------------------
+def _lifecycle_calls(scope_node: ast.AST, ctor_names: Set[str]
+                     ) -> Tuple[List[Tuple[str, ast.Call, str]], Set[str]]:
+    """(alloc sites as (receiver, call, method), freed receivers) within
+    one class body or module scope."""
+    allocs: List[Tuple[str, ast.Call, str]] = []
+    freed: Set[str] = set()
+    for node in ast.walk(scope_node):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        method = node.func.attr
+        if method not in _ALLOC_METHODS | _FREE_METHODS:
+            continue
+        recv = _recv_key(node.func.value)
+        if recv is None or recv in ("self", "cls"):
+            continue  # the allocator's own internals
+        if not _alloc_like(recv, ctor_names):
+            continue
+        if method in _FREE_METHODS:
+            freed.add(recv)
+        else:
+            allocs.append((recv, node, method))
+    return allocs, freed
+
+
+def _ctor_receivers(scope_node: ast.AST) -> Set[str]:
+    """Names assigned from ``SomethingAllocator(...)`` constructions."""
+    out: Set[str] = set()
+    for node in ast.walk(scope_node):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        callee = node.value.func
+        cname = callee.attr if isinstance(callee, ast.Attribute) else \
+            callee.id if isinstance(callee, ast.Name) else ""
+        if not cname.endswith("Allocator"):
+            continue
+        for t in node.targets:
+            key = _recv_key(t)
+            if key:
+                out.add(key)
+    return out
+
+
+def _check_alloc_pairing(mod: SourceModule, symtab,
+                         findings: List[Finding]) -> None:
+    # class scopes first; anything outside a class pairs at module scope
+    class_nodes = symtab.classes[mod.rel]
+    covered: Set[int] = set()
+    scopes: List[Tuple[str, ast.AST]] = []
+    for cls in class_nodes:
+        scopes.append((cls.name, cls))
+        for sub in ast.walk(cls):
+            covered.add(id(sub))
+    scopes.append(("<module>", mod.tree))
+    for label, scope_node in scopes:
+        ctors = _ctor_receivers(scope_node)
+        allocs, freed = _lifecycle_calls(scope_node, ctors)
+        for recv, call, method in allocs:
+            if label == "<module>" and id(call) in covered:
+                continue  # already judged inside its class
+            if recv in freed:
+                continue
+            findings.append(Finding(
+                rule="LIFE001", severity=Severity.ERROR, path=mod.rel,
+                line=call.lineno, col=call.col_offset,
+                message=f"`{_src(call)}` — {label} never calls "
+                        f"{recv}.free(...), so this "
+                        f"{method} has no path to the pool's refcount "
+                        f"decrement (finish, preemption and quarantine "
+                        f"all must end in free)",
+                scope=enclosing_scope(call),
+                detail=f"{method}:{recv}"))
+
+
+# ---------------------------------------------------------------------------
+# LIFE002 — terminal status stamped outside _terminalize
+# ---------------------------------------------------------------------------
+def _status_value_terminal(value: ast.AST) -> Optional[str]:
+    """'FAILED' when ``value`` mentions ``RequestStatus.<member>``."""
+    for node in ast.walk(value):
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "RequestStatus":
+            return node.attr
+    return None
+
+
+def _check_terminal_stamps(mod: SourceModule, findings: List[Finding]
+                           ) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.Assign, ast.AugAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        hits = [t for t in targets
+                if isinstance(t, ast.Attribute) and t.attr == "status"]
+        if not hits or node.value is None:
+            continue
+        member = _status_value_terminal(node.value)
+        if member is None:
+            continue
+        fn = enclosing_function(node)
+        if fn is not None and fn.name == TERMINALIZE:
+            continue
+        findings.append(Finding(
+            rule="LIFE002", severity=Severity.ERROR, path=mod.rel,
+            line=node.lineno, col=node.col_offset,
+            message=f"terminal RequestStatus.{member} assigned outside "
+                    f"{TERMINALIZE}() — the single stamp point is what "
+                    f"makes terminal states exactly-once (and what "
+                    f"frees the KV); route through the scheduler",
+            scope=enclosing_scope(node), detail=member))
+
+
+# ---------------------------------------------------------------------------
+# LIFE003 — undocumented FaultInjector sites
+# ---------------------------------------------------------------------------
+def documented_sites(root: str) -> Optional[Set[str]]:
+    path = os.path.join(root, SITE_DOC)
+    if not os.path.isfile(path):
+        return None
+    with open(path, "r", encoding="utf-8") as f:
+        return set(_BACKTICK_RE.findall(f.read()))
+
+
+def _injector_site(call: ast.Call) -> Optional[ast.Constant]:
+    """The site literal of ``<injector>.check("a.b", ...)`` — receiver
+    must look injector-ish (``get_fault_injector()`` / ``*injector*`` /
+    ``fi``)."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "check"
+            and call.args):
+        return None
+    a0 = call.args[0]
+    if not (isinstance(a0, ast.Constant) and isinstance(a0.value, str)
+            and "." in a0.value):
+        return None
+    recv = _recv_key(f.value) or ""
+    recv_l = recv.lower()
+    if "injector" in recv_l or "fault" in recv_l or \
+            recv_l in ("fi", "fi()"):
+        return a0
+    return None
+
+
+def _check_injector_sites(mod: SourceModule, symtab, catalog: Set[str],
+                          findings: List[Finding]) -> None:
+    for call in symtab.calls[mod.rel]:
+        lit = _injector_site(call)
+        if lit is None or lit.value in catalog:
+            continue
+        findings.append(Finding(
+            rule="LIFE003", severity=Severity.WARNING, path=mod.rel,
+            line=lit.lineno, col=lit.col_offset,
+            message=f"fault-injection site {lit.value!r} is not in the "
+                    f"documented site catalog ({SITE_DOC}) — an "
+                    f"undocumented site is a failure path the chaos "
+                    f"matrix never sweeps",
+            scope=enclosing_scope(call), detail=lit.value))
+
+
+def run(project: Project) -> List[Finding]:
+    symtab = get_symtab(project)
+    catalog = documented_sites(project.root)
+    findings: List[Finding] = []
+    for mod in project.modules:
+        _check_alloc_pairing(mod, symtab, findings)
+        _check_terminal_stamps(mod, findings)
+        if catalog is not None:
+            _check_injector_sites(mod, symtab, catalog, findings)
+    return findings

@@ -322,9 +322,10 @@ def _joined(mod: SourceModule, target: Optional[str],
     return False
 
 
-def _check_threads(mod: SourceModule, findings: List[Finding]) -> None:
-    for node in ast.walk(mod.tree):
-        if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+def _check_threads(mod: SourceModule, calls: List[ast.Call],
+                   findings: List[Finding]) -> None:
+    for node in calls:
+        if not _is_thread_ctor(node):
             continue
         if _daemon_true(node):
             continue
@@ -349,12 +350,11 @@ def _check_threads(mod: SourceModule, findings: List[Finding]) -> None:
 
 
 def run(project: Project) -> List[Finding]:
-    from .hotpath import _annotate_parents
+    from .core import get_symtab
+    symtab = get_symtab(project)  # parents annotated, classes/calls indexed
     findings: List[Finding] = []
     for mod in project.modules:
-        _annotate_parents(mod.tree)
-        for node in ast.walk(mod.tree):
-            if isinstance(node, ast.ClassDef):
-                findings += _ClassAnalysis(mod, node).findings()
-        _check_threads(mod, findings)
+        for node in symtab.classes[mod.rel]:
+            findings += _ClassAnalysis(mod, node).findings()
+        _check_threads(mod, symtab.calls[mod.rel], findings)
     return findings

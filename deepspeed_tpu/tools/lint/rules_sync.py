@@ -21,40 +21,45 @@ from __future__ import annotations
 import ast
 from typing import List, Set
 
-from .core import Finding, Project, Severity
+from .core import (Finding, Project, Severity, callee_name as
+                   _callee_name, src_of as _src)
 from .hotpath import FuncInfo, get_hot, iter_own_nodes
 
 #: the one blessed sync point — calls to it (and its own body) are exempt
 HOST_TRANSFER = "host_transfer"
 
 #: calls that return plain host scalars; float()/int() of these is fine
+#: (``isfinite`` joined in PR 7 — but ONLY the ``math.isfinite`` form:
+#: it REQUIRES a host float, so a name derived from it cannot be a
+#: device value, whereas np/jnp.isfinite of a device value returns a
+#: device bool — ``_is_host_scalar_call`` makes that distinction)
 _HOST_SCALAR_CALLS = {
     "len", "str", "ord", "round", "id", "hash", "getattr", "int", "float",
     "bool", "sum", "perf_counter", "monotonic", "time", "process_time",
-    "get", "getpid", "cpu_count", "prod", HOST_TRANSFER,
+    "get", "getpid", "cpu_count", "prod", "isfinite", HOST_TRANSFER,
 }
+
+
+def _is_host_scalar_call(node: ast.Call) -> bool:
+    name = _callee_name(node)
+    if name not in _HOST_SCALAR_CALLS:
+        return False
+    if name == "isfinite":
+        # math.isfinite only — jnp/np.isfinite of a device value is a
+        # device bool and float()/int() of it is a real sync
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            return False
+        root = f.value
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        return isinstance(root, ast.Name) and root.id == "math"
+    return True
 
 #: (root-name, attr) or bare attr names that force a blocking transfer
 _TRANSFER_ATTRS = {"asarray", "array", "device_get", "block_until_ready",
                    "copy_to_host", "ascontiguousarray"}
 _TRANSFER_ROOTS = {"np", "numpy", "jax", "onp"}
-
-
-def _callee_name(node: ast.Call) -> str:
-    f = node.func
-    if isinstance(f, ast.Name):
-        return f.id
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    return ""
-
-
-def _src(node: ast.AST, limit: int = 48) -> str:
-    try:
-        s = ast.unparse(node)
-    except Exception:  # pragma: no cover - unparse failures
-        s = "<expr>"
-    return s if len(s) <= limit else s[: limit - 3] + "..."
 
 
 def _computed_names(func_node: ast.AST) -> Set[str]:
@@ -67,8 +72,7 @@ def _computed_names(func_node: ast.AST) -> Set[str]:
             if value is None:
                 continue
             has_call = any(
-                isinstance(n, ast.Call)
-                and _callee_name(n) not in _HOST_SCALAR_CALLS
+                isinstance(n, ast.Call) and not _is_host_scalar_call(n)
                 for n in ast.walk(value))
             if not has_call:
                 continue
@@ -122,8 +126,7 @@ def _check_func(info: FuncInfo, in_jit: bool, findings: List[Finding]
                 and len(node.args) == 1 and not node.keywords:
             a = node.args[0]
             suspicious = (
-                (isinstance(a, ast.Call)
-                 and _callee_name(a) not in _HOST_SCALAR_CALLS)
+                (isinstance(a, ast.Call) and not _is_host_scalar_call(a))
                 or (isinstance(a, ast.Name) and a.id in computed))
             if suspicious:
                 findings.append(Finding(

@@ -22,7 +22,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from .core import Finding, Project, Severity
+from .core import Finding, Project, Severity, src_of as _src
 from .hotpath import FuncInfo, JitWrap, get_hot, iter_own_nodes
 
 #: attribute projections of a traced array that are static Python values
@@ -47,14 +47,6 @@ def _dotted(node: ast.AST) -> str:
     if isinstance(node, ast.Name):
         parts.append(node.id)
     return ".".join(reversed(parts))
-
-
-def _src(node: ast.AST, limit: int = 48) -> str:
-    try:
-        s = ast.unparse(node)
-    except Exception:  # pragma: no cover
-        s = "<expr>"
-    return s if len(s) <= limit else s[: limit - 3] + "..."
 
 
 def _is_static_occurrence(name_node: ast.Name) -> bool:

@@ -1,0 +1,101 @@
+"""SARIF 2.1.0 emitter — CI-consumable findings.
+
+SARIF (Static Analysis Results Interchange Format, OASIS 2.1.0) is the
+interchange format CI forges understand natively: uploading
+``lint.sarif`` gets findings annotated inline on the diff instead of
+buried in a job log.  The emitter maps:
+
+  * ``Finding.severity``     → ``result.level`` (error/warning/note)
+  * ``Finding.key``          → ``partialFingerprints`` (line-independent
+    identity, so CI dedup survives unrelated edits — same property the
+    baseline relies on)
+  * baselined findings       → ``suppressions`` (kind ``external``), so
+    they render as suppressed instead of as live findings
+
+Structure follows the 2.1.0 schema's required properties
+(``version``, ``runs[].tool.driver.name``, per-result ``message``);
+``tests/unit/test_lint.py`` pins the invariants a validator would.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+from .core import Finding, Severity
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+_LEVEL = {Severity.ERROR: "error", Severity.WARNING: "warning",
+          Severity.INFO: "note"}
+
+
+def to_sarif(findings: Sequence[Finding],
+             baselined: Sequence[Finding] = (),
+             rule_catalog: Optional[Dict[str, str]] = None) -> dict:
+    """Build the SARIF log dict for ``findings`` (new) + ``baselined``
+    (reported suppressed).  ``rule_catalog`` maps rule id → short
+    description for the driver's rule metadata."""
+    rule_catalog = rule_catalog or {}
+    baselined_set = {id(f) for f in baselined}
+    ordered: List[Finding] = list(findings) + list(baselined)
+    rule_ids = sorted({f.rule for f in ordered} | set(rule_catalog))
+    rule_index = {r: i for i, r in enumerate(rule_ids)}
+
+    results = []
+    for f in ordered:
+        res = {
+            "ruleId": f.rule,
+            "ruleIndex": rule_index[f.rule],
+            "level": _LEVEL.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": f.col + 1},
+                },
+            }],
+            "partialFingerprints": {"dstpuLintKey/v1": f.key},
+        }
+        if f.scope:
+            res["locations"][0]["logicalLocations"] = [
+                {"fullyQualifiedName": f.scope}]
+        if id(f) in baselined_set:
+            res["suppressions"] = [{
+                "kind": "external",
+                "justification": "grandfathered in lint_baseline.json",
+            }]
+        results.append(res)
+
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "dstpu-lint",
+                "informationUri": "docs/lint.md",
+                "rules": [{
+                    "id": r,
+                    "shortDescription": {
+                        "text": rule_catalog.get(r, r)},
+                } for r in rule_ids],
+            }},
+            "columnKind": "unicodeCodePoints",
+            "originalUriBaseIds": {
+                "SRCROOT": {"description": {
+                    "text": "repository root the lint ran from"}}},
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(path: str, findings: Sequence[Finding],
+                baselined: Sequence[Finding] = (),
+                rule_catalog: Optional[Dict[str, str]] = None) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(to_sarif(findings, baselined, rule_catalog), f,
+                  indent=2)
+        f.write("\n")

@@ -4,16 +4,15 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
 
 from deepspeed_tpu import comm
 from deepspeed_tpu.comm import ReduceOp
 from deepspeed_tpu.comm.comms_logging import configure as log_configure
+from deepspeed_tpu.parallel.shard_map_compat import shard_map
 
 
 def _smap(mesh, fn, in_spec, out_spec):
-    return shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
-                     check_vma=False)
+    return shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec)
 
 
 def test_all_reduce_sum(mesh8):

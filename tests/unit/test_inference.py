@@ -621,7 +621,12 @@ class TestChunkedDecodeKernel:
                                    atol=2e-4)
 
     def test_unpadded_cache_length(self):
-        """Cache lengths that don't divide the chunk get padded+masked."""
+        """Cache lengths that don't divide the chunk stream through a
+        ceil-divided grid with NO jnp.pad full-cache copy (dstpu-lint
+        PALLAS004): the tail chunk reads past the cache's end, and
+        interpret mode deliberately poisons those rows with NaN — so
+        this test also pins the masked-v-row zeroing convention
+        (PALLAS002 class: 0 * NaN would leak into the accumulator)."""
         from deepspeed_tpu.ops.transformer.decode_attention import (
             decode_attention)
         rng = np.random.default_rng(1)

@@ -524,12 +524,11 @@ def test_repo_engine_backward_jit_cached(repo_findings):
 
 
 def test_repo_config_schema_consistent(repo_findings):
-    """config.py parses no raw string keys, and the only unconsumed
-    constants are the documented legacy surface (MOE, ROUTE_*)."""
+    """config.py parses no raw string keys and EVERY constant has a
+    consumer — the MOE/ROUTE_* legacy orphans were deleted in PR 7, so
+    any CFG001 here is a fresh schema lie, not grandfathered history."""
     assert [f for f in repo_findings if f.rule == "CFG003"] == []
-    cfg1 = {f.detail for f in repo_findings if f.rule == "CFG001"}
-    assert cfg1 <= {"MOE", "ROUTE_TRAIN", "ROUTE_EVAL", "ROUTE_PREDICT",
-                    "ROUTE_ENCODE"}
+    assert [f for f in repo_findings if f.rule == "CFG001"] == []
     assert not any(f.rule == "CFG002" for f in repo_findings)
 
 
@@ -538,18 +537,31 @@ def test_repo_markers_registered():
 
 
 def test_repo_clean_against_committed_baseline(repo_findings):
-    """The CI gate, as a test: the committed baseline grandfathers every
-    current finding — any new hazard fails here first."""
+    """The CI gate, as a test — PR 7 burned the baseline to ZERO by
+    fixing (not suppressing) all 20 grandfathered findings, so the tree
+    must be finding-free against an EMPTY baseline: the ratchet is
+    fully tightened and any hazard fails here first."""
     bl = Baseline.load(os.path.join(REPO_ROOT, "lint_baseline.json"))
+    assert bl.counts == {}, "baseline must stay empty — fix, don't add"
     new, _ = bl.split(repo_findings)
     assert new == [], "\n".join(f.render() for f in new)
 
 
-def test_repo_lint_reports_multiple_families(repo_findings):
-    """The analyzer exercises >= 3 rule families on the real runtime
-    (the 4th, LOCK, is clean since this PR fixed its findings)."""
-    fams = {f.family for f in repo_findings}
-    assert {"SYNC", "TRACE", "CFG"} <= fams
+def test_repo_true_positive_fixes_stay_fixed(repo_findings):
+    """Regression pins for the PR 7 live-tree fixes: the offload step's
+    scattered float() syncs now ride ONE batched host_transfer
+    (SYNC002/SYNC003), the init/onebit jit builds are cached (TRACE003),
+    every shard_map call routes through the compat shim (MESH004 —
+    ring/ulysses were AttributeError-dead on the pinned jax), and the
+    decode kernel streams ragged tails without a full-cache jnp.pad
+    (PALLAS004)."""
+    assert [f.render() for f in repo_findings
+            if f.scope.endswith("_offload_train_step")
+            or "shard_batch" in f.scope] == []
+    assert [f.render() for f in repo_findings if f.rule == "TRACE003"] == []
+    assert [f.render() for f in repo_findings if f.family == "MESH"] == []
+    assert [f.render() for f in repo_findings if f.family == "PALLAS"] == []
+    assert [f.render() for f in repo_findings if f.family == "LIFE"] == []
 
 
 # ---------------------------------------------------------------------------
@@ -627,3 +639,529 @@ def test_slot_store_close_waits_for_pins(tmp_path):
     store2.close()
     assert _time.monotonic() - t0 >= 0.3      # waited the full budget
     assert store2._bufs == []
+
+
+# ---------------------------------------------------------------------------
+# PALLAS family — kernel hazards (PR 7)
+# ---------------------------------------------------------------------------
+def test_pallas_compiler_params_bypass(tmp_path):
+    fs = run_lint(tmp_path, {"ops/kern.py": """\
+        from jax.experimental.pallas import tpu as pltpu
+
+        def build():
+            return pltpu.CompilerParams(dimension_semantics=("parallel",))
+
+        def build_old():
+            return pltpu.TPUCompilerParams()
+        """})
+    assert [f.rule for f in fs].count("PALLAS001") == 2
+    assert all(f.severity == "error" for f in fs)
+
+
+def test_pallas_compiler_params_shim_exempt(tmp_path):
+    """The shim module itself (and compiler_params() users) stay clean."""
+    fs = run_lint(tmp_path, {"ops/pallas_compat.py": """\
+        from jax.experimental.pallas import tpu as pltpu
+        _CLS = getattr(pltpu, "CompilerParams", None) or \\
+            getattr(pltpu, "TPUCompilerParams")
+
+        def compiler_params(**kw):
+            return _CLS(**kw)
+        """, "ops/kern.py": """\
+        from .pallas_compat import compiler_params
+
+        def build():
+            return compiler_params(dimension_semantics=("parallel",))
+        """})
+    assert [f for f in fs if f.rule == "PALLAS001"] == []
+
+
+def test_pallas_select_by_multiply(tmp_path):
+    """The PR 6 NaN-leak class: mask * v in a kernel is flagged; the
+    jnp.where form (and plain prob-times-value products) are not."""
+    fs = run_lint(tmp_path, {"ops/kern.py": """\
+        import jax
+        import jax.numpy as jnp
+
+        def _kernel(len_ref, q_ref, v_ref, o_ref):
+            pos = jax.lax.broadcasted_iota(jnp.int32, (8, 4), 0)
+            mask = pos < len_ref[0]
+            v = v_ref[...]
+            bad = mask * v                    # select-by-multiply
+            worse = v * (pos < len_ref[0])    # inline comparison
+            probs = jnp.exp(v)
+            fine = probs * v                  # not a mask product
+            good = jnp.where(mask, v, 0.0)
+            o_ref[...] = bad + worse + fine + good
+        """})
+    hits = [f for f in fs if f.rule == "PALLAS002"]
+    assert len(hits) == 2 and all(f.severity == "error" for f in hits)
+    assert sorted(h.detail for h in hits) == [
+        "mult:mask", "mult:pos < len_ref[0]"]
+
+
+def test_pallas_select_by_multiply_only_in_kernels(tmp_path):
+    """MoE gating etc. legitimately multiplies by masks OUTSIDE kernels
+    — the rule scopes to pallas kernel functions (>=2 *_ref params or
+    passed to pallas_call)."""
+    fs = run_lint(tmp_path, {"moe.py": """\
+        import jax.numpy as jnp
+
+        def gate(scores, k):
+            mask = scores > 0
+            return scores * mask
+        """})
+    assert [f for f in fs if f.rule == "PALLAS002"] == []
+
+
+def test_pallas_scratch_dtype(tmp_path):
+    fs = run_lint(tmp_path, {"ops/kern.py": """\
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _kernel(x_ref, o_ref, acc):
+            o_ref[...] = x_ref[...]
+
+        def wrapper(x):
+            return pl.pallas_call(
+                _kernel,
+                grid=(1,),
+                scratch_shapes=[pltpu.VMEM((8, 128), jnp.bfloat16)],
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+
+        def wrapper_ok(x):
+            return pl.pallas_call(
+                _kernel,
+                grid=(1,),
+                scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32)],
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+        """})
+    hits = [f for f in fs if f.rule == "PALLAS003"]
+    assert len(hits) == 1 and hits[0].detail == "bfloat16"
+
+
+def test_pallas_pad_in_wrapper(tmp_path):
+    fs = run_lint(tmp_path, {"ops/kern.py": """\
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def wrapper(x):
+            x = jnp.pad(x, ((0, 3),))
+            return pl.pallas_call(
+                _kernel, grid=(1,),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+
+        def elsewhere(x):
+            return jnp.pad(x, ((0, 3),))   # not a kernel wrapper: fine
+        """})
+    hits = [f for f in fs if f.rule == "PALLAS004"]
+    assert len(hits) == 1 and hits[0].scope == "wrapper"
+
+
+def test_pallas_index_map_hazards(tmp_path):
+    fs = run_lint(tmp_path, {"ops/kern.py": """\
+        import time
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        class K:
+            def build(self, block):
+                def bad_state(i, p, len_ref):
+                    return (self.offset + i, 0)    # mutable capture
+
+                def bad_host(i, p, len_ref):
+                    return (int(time.time()) + i, 0)
+
+                def good(i, p, len_ref):
+                    last = jnp.maximum(len_ref[i] // block - 1, 0)
+                    return (jnp.minimum(p, last), 0)
+
+                return [pl.BlockSpec((1, block), bad_state),
+                        pl.BlockSpec((1, block), bad_host),
+                        pl.BlockSpec((1, block), good)]
+        """})
+    hits = [f for f in fs if f.rule == "PALLAS005"]
+    assert {h.scope for h in hits} == {"bad_state", "bad_host"}
+    assert not any(h.scope == "good" for h in hits)
+
+
+# ---------------------------------------------------------------------------
+# MESH family — sharding discipline (PR 7)
+# ---------------------------------------------------------------------------
+_TOPO_FIXTURE = """\
+    AXIS_ORDER = ("dcn_data", "pipe", "data", "expert", "sequence",
+                  "model")
+    DATA_AXIS = "data"
+    MODEL_AXIS = "model"
+    """
+
+
+def test_mesh_explicit_specs_required(tmp_path):
+    fs = run_lint(tmp_path, {
+        "parallel/topology.py": _TOPO_FIXTURE,
+        "m.py": """\
+        from deepspeed_tpu.parallel.shard_map_compat import shard_map
+
+        def good(f, mesh, spec):
+            return shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)
+
+        def bad(f, mesh):
+            return shard_map(f, mesh=mesh)
+        """})
+    hits = [f for f in fs if f.rule == "MESH001"]
+    assert len(hits) == 1 and hits[0].scope == "bad"
+
+
+def test_mesh_undeclared_axis_literal(tmp_path):
+    fs = run_lint(tmp_path, {
+        "parallel/topology.py": _TOPO_FIXTURE,
+        "m.py": """\
+        import jax
+
+        def body(x):
+            good = jax.lax.psum(x, "data")
+            also = jax.lax.pmean(x, axis_name="model")
+            bad = jax.lax.psum(x, "bogus_axis")
+            idx = jax.lax.axis_index("sequnce")   # typo'd
+            return good + also + bad + idx
+        """})
+    hits = sorted(f.detail for f in fs if f.rule == "MESH002")
+    assert hits == ["axis_index:sequnce", "psum:bogus_axis"]
+
+
+def test_mesh_no_topology_module_stays_silent(tmp_path):
+    """Without a parallel/topology.py the declared-axis set is unknown —
+    the rule must not guess."""
+    fs = run_lint(tmp_path, {"m.py": """\
+        import jax
+
+        def body(x):
+            return jax.lax.psum(x, "whatever")
+        """})
+    assert [f for f in fs if f.rule == "MESH002"] == []
+
+
+def test_mesh_ctor_outside_topology(tmp_path):
+    fs = run_lint(tmp_path, {
+        "parallel/topology.py": _TOPO_FIXTURE + """\
+
+    def build_mesh(devices):
+        from jax.sharding import Mesh
+        return Mesh(devices, AXIS_ORDER)   # the one blessed site
+    """,
+        "m.py": """\
+        from jax.sharding import Mesh
+
+        def sneaky(devices):
+            return Mesh(devices, ("data",))
+
+        def hardcoded(d0, d1):
+            return Mesh([d0, d1], ("data",))
+        """})
+    hits = {f.scope: f for f in fs if f.rule == "MESH003"}
+    assert set(hits) == {"sneaky", "hardcoded"}
+    assert hits["sneaky"].severity == "warning"
+    assert hits["hardcoded"].severity == "error"
+
+
+def test_mesh_shard_map_compat_bypass(tmp_path):
+    """The rename class that killed ring/ulysses on the pinned jax:
+    jax.shard_map attribute use AND experimental imports are flagged;
+    the compat wrapper import is the fix."""
+    fs = run_lint(tmp_path, {
+        "parallel/topology.py": _TOPO_FIXTURE,
+        "a.py": """\
+        import jax
+
+        def f(body, mesh, spec):
+            return jax.shard_map(body, mesh=mesh, in_specs=spec,
+                                 out_specs=spec)
+        """,
+        "b.py": """\
+        from jax.experimental.shard_map import shard_map
+
+        def f(body, mesh, spec):
+            return shard_map(body, mesh=mesh, in_specs=spec,
+                             out_specs=spec)
+        """,
+        "c.py": """\
+        from deepspeed_tpu.parallel.shard_map_compat import shard_map
+
+        def f(body, mesh, spec):
+            return shard_map(body, mesh=mesh, in_specs=spec,
+                             out_specs=spec)
+        """})
+    hits = {f.path for f in fs if f.rule == "MESH004"}
+    assert hits == {"a.py", "b.py"}
+
+
+# ---------------------------------------------------------------------------
+# LIFE family — resource lifecycle (PR 7)
+# ---------------------------------------------------------------------------
+def test_life_alloc_without_free(tmp_path):
+    fs = run_lint(tmp_path, {"serving.py": """\
+        class Leaky:
+            def __init__(self, alloc):
+                self.alloc = alloc
+
+            def admit(self, seq, tokens):
+                table, cached = self.alloc.allocate(seq, tokens)
+                return table
+
+        class Paired:
+            def __init__(self, alloc):
+                self.alloc = alloc
+
+            def admit(self, seq, tokens):
+                return self.alloc.allocate(seq, tokens)
+
+            def finish(self, seq):
+                self.alloc.free(seq)
+
+            def preempt(self, seq):
+                self.alloc.free(seq, discard=True)
+        """})
+    hits = [f for f in fs if f.rule == "LIFE001"]
+    assert len(hits) == 1 and hits[0].scope == "Leaky.admit"
+
+
+def test_life_fork_counts_as_alloc(tmp_path):
+    fs = run_lint(tmp_path, {"serving.py": """\
+        class Forker:
+            def __init__(self, allocator):
+                self.allocator = allocator
+
+            def split(self, seq, new):
+                self.allocator.fork(seq, new)
+        """})
+    hits = [f for f in fs if f.rule == "LIFE001"]
+    assert len(hits) == 1 and hits[0].detail.startswith("fork:")
+
+
+def test_life_non_allocator_receivers_exempt(tmp_path):
+    """allocate() on something that is not allocator-shaped (no 'alloc'
+    in the receiver, no *Allocator construction) is out of scope."""
+    fs = run_lint(tmp_path, {"m.py": """\
+        class Client:
+            def __init__(self, arena):
+                self.arena = arena
+
+            def get(self):
+                return self.arena.allocate(4096)
+        """})
+    assert [f for f in fs if f.rule == "LIFE001"] == []
+
+
+def test_life_terminal_status_outside_terminalize(tmp_path):
+    fs = run_lint(tmp_path, {"serving.py": """\
+        import enum
+
+        class RequestStatus(enum.Enum):
+            OK = "ok"
+            FAILED = "failed"
+
+        class Scheduler:
+            def _terminalize(self, req, status):
+                req.status = req.status or status     # the one stamp point
+
+            def quarantine(self, req):
+                req.status = RequestStatus.FAILED     # bypasses it
+
+        class Engine:
+            def cancel(self, req):
+                req.status = RequestStatus.OK         # bypasses it
+        """})
+    hits = sorted(f.detail for f in fs if f.rule == "LIFE002")
+    assert hits == ["FAILED", "OK"]
+
+
+def test_life_undocumented_injector_site(tmp_path):
+    fs = run_lint(tmp_path, {
+        "docs_stub.py": "",
+        "m.py": """\
+        from .resilience import get_fault_injector
+
+        def hot_path():
+            get_fault_injector().check("serving.allocate")
+            get_fault_injector().check("serving.brand_new_site")
+        """})
+    # write the catalog AFTER run_lint created the tree, then re-lint
+    doc = tmp_path / "docs" / "resilience.md"
+    doc.parent.mkdir(exist_ok=True)
+    doc.write_text("Sites: `serving.allocate`, `other.site`.\n")
+    fs = lint_paths([str(tmp_path)], root=str(tmp_path))
+    hits = [f for f in fs if f.rule == "LIFE003"]
+    assert len(hits) == 1 and hits[0].detail == "serving.brand_new_site"
+
+
+def test_life_no_catalog_doc_stays_silent(tmp_path):
+    fs = run_lint(tmp_path, {"m.py": """\
+        from .resilience import get_fault_injector
+
+        def hot_path():
+            get_fault_injector().check("serving.allocate")
+        """})
+    assert [f for f in fs if f.rule == "LIFE003"] == []
+
+
+def test_repo_injector_sites_all_documented(repo_findings):
+    """Every live FaultInjector site appears in docs/resilience.md's
+    catalog (LIFE003 green on the real tree)."""
+    assert [f.render() for f in repo_findings if f.rule == "LIFE003"] == []
+
+
+# ---------------------------------------------------------------------------
+# engine invariants (PR 7): self-lint, single-parse pin, SARIF
+# ---------------------------------------------------------------------------
+def test_analyzer_clean_on_own_source():
+    """The linter lints itself (tools/lint) with no baseline: an
+    analyzer that trips its own rules cannot be trusted to arbitrate
+    anyone else's."""
+    lint_dir = os.path.join(PKG, "tools", "lint")
+    fs = lint_paths([lint_dir], root=REPO_ROOT)
+    assert fs == [], "\n".join(f.render() for f in fs)
+
+
+def test_single_parse_matches_per_family_parse():
+    """Byte-identical findings from the shared-symbol-table run vs a
+    fresh parse per family — pins that the PR 7 single-parse refactor
+    changed performance, not semantics."""
+    from deepspeed_tpu.tools.lint.core import all_families, load_project
+    shared = load_project([PKG], root=REPO_ROOT)
+    combined = []
+    for _name, run in all_families():
+        combined += run(shared)             # one Project, one symtab
+    separate = []
+    for _name, run in all_families():
+        fresh = load_project([PKG], root=REPO_ROOT)   # re-parse per family
+        separate += run(fresh)
+    key = lambda f: (f.path, f.line, f.col, f.rule)   # noqa: E731
+    blob_a = "\n".join(f.render() for f in sorted(combined, key=key))
+    blob_b = "\n".join(f.render() for f in sorted(separate, key=key))
+    assert blob_a.encode() == blob_b.encode()
+
+
+def _sarif_of(tmp_path, sources, baseline_findings=0):
+    from deepspeed_tpu.tools.lint.cli import RULE_CATALOG
+    from deepspeed_tpu.tools.lint.sarif import to_sarif
+    fs = run_lint(tmp_path, sources)
+    return fs, to_sarif(fs[baseline_findings:], fs[:baseline_findings],
+                        RULE_CATALOG)
+
+
+def test_sarif_validates_against_2_1_0_schema(tmp_path):
+    """Structural validation of the invariants the 2.1.0 schema
+    requires: version/$schema, runs[].tool.driver.name + rules[].id,
+    results[].{ruleId,message.text,locations[].physicalLocation},
+    1-based columns, levels from the sarif vocabulary, and suppressions
+    on baselined results."""
+    fs, log = _sarif_of(tmp_path, {"m.py": """\
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.item()
+        """}, baseline_findings=1)
+    assert log["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in log["$schema"]
+    assert len(log["runs"]) == 1
+    run = log["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "dstpu-lint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert rule_ids == sorted(rule_ids) and "SYNC001" in rule_ids
+    for r in driver["rules"]:
+        assert r["shortDescription"]["text"]
+    assert run["results"], "findings must emit results"
+    for res in run["results"]:
+        assert res["ruleId"] in rule_ids
+        assert driver["rules"][res["ruleIndex"]]["id"] == res["ruleId"]
+        assert res["level"] in ("none", "note", "warning", "error")
+        assert res["message"]["text"]
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "m.py"
+        assert loc["region"]["startLine"] >= 1
+        assert loc["region"]["startColumn"] >= 1
+        assert res["partialFingerprints"]["dstpuLintKey/v1"]
+    # the baselined finding is suppressed, the live one is not
+    suppressed = [r for r in run["results"] if r.get("suppressions")]
+    assert len(suppressed) == 1
+    assert suppressed[0]["suppressions"][0]["kind"] == "external"
+
+
+def test_sarif_cli_artifact(tmp_path, capsys):
+    """--sarif writes a loadable artifact alongside the normal gate."""
+    src = tmp_path / "pkg"
+    src.mkdir()
+    (src / "m.py").write_text(textwrap.dedent("""\
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.item()
+        """))
+    out = tmp_path / "lint.sarif"
+    rc = lint_main([str(src), "--root", str(tmp_path), "--no-baseline",
+                    "--sarif", str(out)])
+    capsys.readouterr()
+    assert rc == 1
+    log = json.loads(out.read_text())
+    assert log["version"] == "2.1.0"
+    assert log["runs"][0]["results"]
+
+
+def test_min_severity_filter(tmp_path):
+    """Severity tiers: --min-severity error drops the warning-tier
+    findings (step-hot SYNC is warning; jit-hot is error)."""
+    sources = {"m.py": """\
+        import numpy as np
+
+        def train_step(batch):
+            return np.asarray(batch)
+        """}
+    warn = run_lint(tmp_path, sources)
+    assert any(f.severity == "warning" for f in warn)
+    errs = lint_paths([str(tmp_path)], root=str(tmp_path),
+                      min_severity="error")
+    assert errs == []
+
+
+def test_mesh_axis_kwarg_does_not_mask_positional_name(tmp_path):
+    """all_gather's ``axis=`` kwarg is the INTEGER array axis — its
+    presence must not suppress checking the positional axis NAME."""
+    fs = run_lint(tmp_path, {
+        "parallel/topology.py": _TOPO_FIXTURE,
+        "m.py": """\
+        import jax
+
+        def body(x):
+            bad = jax.lax.all_gather(x, "bogus_axis", axis=0)
+            good = jax.lax.all_gather(x, "data", axis=0)
+            return bad + good
+        """})
+    hits = [f.detail for f in fs if f.rule == "MESH002"]
+    assert hits == ["all_gather:bogus_axis"]
+
+
+def test_sync_isfinite_whitelist_is_math_only(tmp_path):
+    """float(math.isfinite(...)) chains are host-scalar; jnp.isfinite of
+    a device value is a device bool and float() of it still flags."""
+    fs = run_lint(tmp_path, {"m.py": """\
+        import math
+        import jax.numpy as jnp
+
+        def train_step(batch):
+            loss = run_program(batch)
+            ok = math.isfinite(1.0)
+            fine = int(ok)
+            bad = float(jnp.isfinite(loss))
+            return fine + bad
+        """})
+    s2 = [f.detail for f in fs if f.rule == "SYNC002"]
+    assert s2 == ["float:jnp.isfinite(loss)"]

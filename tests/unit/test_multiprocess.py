@@ -30,7 +30,8 @@ mesh = Mesh(np.array(jax.devices()).reshape(4), ("data",))
 local = np.full((2, 4), float(process_id + 1), np.float32)
 x = jax.make_array_from_process_local_data(
     NamedSharding(mesh, P("data")), local, (4, 4))
-out = jax.jit(jax.shard_map(lambda v: jax.lax.pmean(v, "data"),
+from deepspeed_tpu.parallel.shard_map_compat import shard_map
+out = jax.jit(shard_map(lambda v: jax.lax.pmean(v, "data"),
     mesh=mesh, in_specs=P("data"), out_specs=P()),
     out_shardings=NamedSharding(mesh, P()))(x)
 got = np.asarray(jax.device_get(out.addressable_data(0)))

@@ -12,6 +12,7 @@ from jax.sharding import PartitionSpec as P
 import deepspeed_tpu as ds
 from deepspeed_tpu.models import TransformerLM, gpt2_config
 from deepspeed_tpu.parallel.topology import build_mesh
+from deepspeed_tpu.parallel.shard_map_compat import shard_map
 from deepspeed_tpu.runtime.comm.compressed import (compressed_allreduce,
                                                    compression_ratio)
 from deepspeed_tpu.runtime.config import MeshConfig
@@ -44,11 +45,10 @@ class TestCompressedAllreduce:
                 outs.append(out)
             return jnp.stack(outs)
 
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             body, mesh=mesh,
             in_specs=(P("dcn_data"), P("dcn_data"), P("dcn_data")),
-            out_specs=P(None, "dcn_data"), axis_names={"dcn_data"},
-            check_vma=False))
+            out_specs=P(None, "dcn_data"), axis_names={"dcn_data"}))
         we = jnp.zeros((w, n))
         se = jnp.zeros((w, n // w))
         return fn(xs[:, None].reshape(w, n), we, se)
@@ -86,10 +86,10 @@ class TestCompressedAllreduce:
 
         def body(x, we, se):
             return compressed_allreduce(x[0], we[0], se[0], "dcn_data")[0]
-        fn = jax.shard_map(body, mesh=mesh,
-                           in_specs=(P("dcn_data"),) * 3,
-                           out_specs=P("dcn_data"),
-                           axis_names={"dcn_data"}, check_vma=False)
+        fn = shard_map(body, mesh=mesh,
+                       in_specs=(P("dcn_data"),) * 3,
+                       out_specs=P("dcn_data"),
+                       axis_names={"dcn_data"})
         with pytest.raises(ValueError, match="divide"):
             jax.jit(fn)(jnp.zeros((8, 3)), jnp.zeros((8, 3)),
                         jnp.zeros((8, 1)))
