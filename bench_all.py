@@ -6,6 +6,7 @@ single-line contract; this script covers the wider matrix: 125M ZeRO-0,
 """
 from __future__ import annotations
 
+import functools
 import json
 import time
 
@@ -251,10 +252,39 @@ def paged_decode_attention_bench(slots: int = 8, heads: int = 16,
         "cache_tokens": [int(x) for x in lens]}), flush=True)
 
 
+def hbm_ceiling_probe() -> float:
+    """Measured HBM bandwidth ceiling (bf16 elementwise chain, best of
+    8 — same discipline as bench.py measure_roofline): the denominator
+    of every roofline_frac this file emits."""
+    import jax
+    import jax.numpy as jnp
+    a = jnp.asarray(np.random.default_rng(0).standard_normal(
+        1 << 26, dtype=np.float32), jnp.bfloat16)
+
+    @jax.jit
+    def ew_chain(a):
+        return jax.lax.fori_loop(
+            0, 20, lambda i, a: a * 1.0000001 + 0.0000001, a)
+
+    y = ew_chain(a)
+    y.block_until_ready()
+    best = float("inf")
+    for _ in range(8):
+        t0 = time.perf_counter()
+        y = ew_chain(y)
+        y.block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return 2 * a.nbytes * 20 / best / 2**30
+
+
 def decode16k_bench(batch: int = 4, heads: int = 16, d: int = 128,
-                    cache: int = 16384, iters: int = 20):
+                    cache: int = 16384, iters: int = 20,
+                    hbm_gbps: float = 0.0):
     """Chunked decode-attention kernel at a 16k KV cache (the workspace
-    the single-block kernel could not serve — VERDICT r2 weak #5)."""
+    the single-block kernel could not serve — VERDICT r2 weak #5).
+    ISSUE 8 reworked the kernel's compute onto the MXU (batched matvec
+    scores, broadcastable [H,1] softmax state); roofline_frac against
+    the probed HBM ceiling is the acceptance metric."""
     import jax
     import jax.numpy as jnp
     from deepspeed_tpu.ops.transformer.decode_attention import (
@@ -276,11 +306,104 @@ def decode16k_bench(batch: int = 4, heads: int = 16, d: int = 128,
     o.block_until_ready()
     ms = (time.perf_counter() - t0) / iters * 1000
     gb = (k.nbytes + v.nbytes) / 2**30
+    gbps = gb / (ms / 1000)
     print(json.dumps({
         "metric": "decode_attention_ms_16k_cache",
         "value": round(ms, 3), "unit": "ms",
         "kv_gib": round(gb, 2),
-        "achieved_gbps": round(gb / (ms / 1000), 1)}), flush=True)
+        "achieved_gbps": round(gbps, 1),
+        "roofline_frac": round(gbps / hbm_gbps, 3) if hbm_gbps else None,
+        "hbm_ceiling_gbps": round(hbm_gbps, 1) if hbm_gbps else None}),
+        flush=True)
+
+
+def paged_decode_roofline_sweep(hbm_gbps: float, slots: int = 8,
+                                heads: int = 16, d: int = 128,
+                                cache: int = 16384, iters: int = 16):
+    """ISSUE 8 roofline sweep: the paged decode kernel across pages-
+    per-program (double-buffer group width) x block size x kv bits.
+    Each point reports the bytes that ACTUALLY cross HBM (compressed
+    values + scales at 8/4-bit) and its fraction of the probed
+    ceiling; ``kv_blocks_capacity_effective`` records how many pool
+    blocks the bf16 pool's HBM budget admits at each width — the
+    concurrency side of the quantization win."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.serving.block_allocator import (
+        blocks_for_budget, kv_block_bytes)
+    from deepspeed_tpu.ops.quantizer import kv_quantize
+    from deepspeed_tpu.ops.transformer.paged_decode_attention import (
+        paged_decode_attention)
+
+    rs = np.random.RandomState(0)
+    best = None
+    for block in (64, 256):
+        pages = cache // block
+        nb = slots * pages + 1
+        lens = np.linspace(cache // 2, cache, slots).astype(np.int32)
+        bt = np.zeros((slots, pages), np.int32)
+        free = 1
+        for i, ln in enumerate(lens):
+            n = -(-int(ln) // block)
+            bt[i, :n] = np.arange(free, free + n)
+            free += n
+        q = jnp.asarray(rs.randn(slots, heads, d), jnp.bfloat16)
+        pk16 = jnp.asarray(rs.randn(nb, block, heads, d), jnp.bfloat16)
+        pv16 = jnp.asarray(rs.randn(nb, block, heads, d), jnp.bfloat16)
+        lens_j, bt_j = jnp.asarray(lens), jnp.asarray(bt)
+        for bits in (0, 8, 4):
+            if bits:
+                pk, ks = kv_quantize(pk16, bits)
+                pv, vs = kv_quantize(pv16, bits)
+            else:
+                pk, pv, ks, vs = pk16, pv16, None, None
+            # bytes one dispatch actually reads: each slot's valid rows,
+            # values + scales, k and v — kv_block_bytes at block_size 1
+            # IS the per-row rule (pinned against init_paged_cache)
+            gb = float(lens.sum()) * kv_block_bytes(1, heads, d,
+                                                    bits) / 2**30
+            for pp in (1, 4, 8):
+                if pp > pages:
+                    continue
+                # pools AND scales ride as arguments (closing over them
+                # would bake them into the executable as constants —
+                # the decode16k_bench discipline)
+                kern = functools.partial(paged_decode_attention,
+                                         kv_bits=bits,
+                                         pages_per_program=pp)
+                f = jax.jit(lambda q, pk, pv, ks, vs, kern=kern:
+                            kern(q, pk, pv, lens_j, bt_j,
+                                 k_scale=ks, v_scale=vs))
+                qq = q
+                o = f(qq, pk, pv, ks, vs)
+                o.block_until_ready()
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    qq = jnp.roll(qq, 1, axis=1)   # genuinely new input
+                    o = f(qq, pk, pv, ks, vs)
+                o.block_until_ready()
+                ms = (time.perf_counter() - t0) / iters * 1000
+                gbps = gb / (ms / 1000)
+                point = {
+                    "metric": "paged_decode_roofline_point",
+                    "block": block, "pages_per_program": pp,
+                    "kv_bits": bits, "ms": round(ms, 3),
+                    "hbm_gib_moved": round(gb, 3),
+                    "achieved_gbps": round(gbps, 1),
+                    "roofline_frac": round(gbps / hbm_gbps, 3)
+                    if hbm_gbps else None}
+                print(json.dumps(point), flush=True)
+                if bits == 0 and (best is None
+                                  or ms < best["ms"]):
+                    best = point
+    budget = 512 * kv_block_bytes(16, heads, d)
+    print(json.dumps({
+        "metric": "kv_blocks_capacity_effective",
+        "unit": "blocks@same_hbm_budget",
+        "budget_bf16_blocks": 512,
+        "value": {str(b): blocks_for_budget(budget, 16, heads, d, b)
+                  for b in (0, 8, 4)},
+        "best_bf16_point": best}), flush=True)
 
 
 def blocksparse_bench(seq: int = 8192, heads: int = 8, d: int = 128,
@@ -611,10 +734,12 @@ def main():
         train_bench("350m", 16, 1024, 2, iters=6)
         train_bench("350m", 16, 1024, 3, iters=6)
         decode_bench()
-        decode16k_bench()
+        hbm = hbm_ceiling_probe()
+        decode16k_bench(hbm_gbps=hbm)
         serving_decode_bench()
         prefix_cache_bench()
         paged_decode_attention_bench()
+        paged_decode_roofline_sweep(hbm)
         blocksparse_bench()
         diffusion_bench()
         host_offload_bench()

@@ -40,6 +40,13 @@ class ServingConfig(ConfigModel):
     # content-addressed prefix caching (RadixAttention-style): shared or
     # resubmitted prefixes reuse pool blocks instead of re-prefilling
     prefix_cache: bool = C.SERVING_PREFIX_CACHE_DEFAULT
+    # quantized KV cache: 0 = engine dtype (byte-identical legacy path),
+    # 8 = int8, 4 = packed int4 — per-row per-head scales stored
+    # alongside, dequant fused into the paged attention kernels; the
+    # same pool HBM budget holds ~2x / ~3.8x the tokens and decode
+    # moves proportionally fewer bytes (docs/serving.md "Quantized KV
+    # cache")
+    kv_cache_bits: int = C.SERVING_KV_CACHE_BITS_DEFAULT
     # -- robustness / overload control (docs/serving.md "Failure
     # handling & overload") --
     # bounded backpressure: submit() beyond this many WAITING requests
@@ -77,6 +84,11 @@ class ServingConfig(ConfigModel):
             raise ValueError(
                 f"serving.prefill_chunk_tokens must be >= 1, got "
                 f"{self.prefill_chunk_tokens}")
+        if self.kv_cache_bits not in (0, 4, 8):
+            raise ValueError(
+                f"serving.kv_cache_bits must be one of 0 (engine "
+                f"dtype), 8 (int8) or 4 (packed int4), got "
+                f"{self.kv_cache_bits}")
         if self.max_queue_depth < 0:
             raise ValueError(
                 f"serving.max_queue_depth must be >= 0 (0 = unbounded), "

@@ -12,11 +12,13 @@ Three layers, composed by ``InferenceEngine.serving_engine()``:
 """
 from ...runtime.resilience.errors import ServingError  # noqa: F401
 from .block_allocator import (BlockPoolError, NULL_BLOCK,  # noqa: F401
-                              PagedBlockAllocator)
+                              PagedBlockAllocator, blocks_for_budget,
+                              kv_block_bytes)
 from .engine import ServingEngine  # noqa: F401
 from .scheduler import (ContinuousBatchingScheduler, Request,  # noqa: F401
                         RequestState, RequestStatus)
 
 __all__ = ["BlockPoolError", "NULL_BLOCK", "PagedBlockAllocator",
            "ContinuousBatchingScheduler", "Request", "RequestState",
-           "RequestStatus", "ServingEngine", "ServingError"]
+           "RequestStatus", "ServingEngine", "ServingError",
+           "kv_block_bytes", "blocks_for_budget"]

@@ -47,6 +47,40 @@ NULL_BLOCK = 0
 #: chain root: the "hash" of the empty prefix
 ROOT_HASH = b""
 
+#: bytes of each per-row per-head dequant scale (f32) stored alongside
+#: a quantized pool block
+_SCALE_BYTES = 4
+
+
+def kv_block_bytes(block_size: int, kv_heads: int, head_dim: int,
+                   kv_bits: int = 0, cache_itemsize: int = 2) -> int:
+    """Device HBM bytes one pool block costs across k AND v, including
+    the per-row per-head f32 scales a quantized pool stores alongside
+    (``serving.kv_cache_bits``).  ``cache_itemsize`` is the
+    unquantized pool's dtype width (2 = bf16).  Pure ints — the
+    capacity-planning mirror of ``models/transformer.py
+    init_paged_cache``, pinned against it by test."""
+    if kv_bits not in (0, 4, 8):
+        raise ValueError(f"kv_bits must be 0, 4 or 8, got {kv_bits}")
+    if kv_bits == 0:
+        per_row = kv_heads * head_dim * cache_itemsize
+    else:
+        values = kv_heads * ((head_dim * kv_bits + 7) // 8)
+        per_row = values + kv_heads * _SCALE_BYTES
+    return 2 * block_size * per_row          # k + v
+
+
+def blocks_for_budget(budget_bytes: int, block_size: int, kv_heads: int,
+                      head_dim: int, kv_bits: int = 0,
+                      cache_itemsize: int = 2) -> int:
+    """Pool blocks (INCLUDING the reserved null block 0) a device HBM
+    budget admits at the given KV width — the ``kv_cache_bits`` sizing
+    rule: the same budget holds ~2x the blocks at 8-bit and ~3.8x at
+    packed 4-bit, which is the concurrency the scheduler can actually
+    admit."""
+    return budget_bytes // kv_block_bytes(block_size, kv_heads, head_dim,
+                                          kv_bits, cache_itemsize)
+
 
 class BlockPoolError(ServingError):
     """Allocator invariant violation (double free, exhaustion, unknown

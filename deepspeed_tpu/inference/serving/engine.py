@@ -105,16 +105,27 @@ class ServingEngine:
             max_preemptions=cfg.max_preemptions)
         self.no_progress_steps = cfg.no_progress_steps
         self.default_deadline_s = cfg.default_deadline_s
+        #: KV-cache width: 0 = engine dtype, 8 = int8, 4 = packed int4
+        #: (``serving.kv_cache_bits``, docs/serving.md "Quantized KV
+        #: cache")
+        self.kv_bits = cfg.kv_cache_bits
         #: consecutive zero-progress iterations (the serving watchdog)
         self._no_progress = 0
-        pools = model.init_paged_cache(cfg.num_kv_blocks, self.block_size,
-                                       dtype=engine.dtype)
+        with trace_span("serving/kv_quantize", bits=self.kv_bits,
+                        blocks=cfg.num_kv_blocks):
+            pools = model.init_paged_cache(cfg.num_kv_blocks,
+                                           self.block_size,
+                                           dtype=engine.dtype,
+                                           kv_bits=self.kv_bits)
         self._pool_k, self._pool_v = pools["k"], pools["v"]
-        kv_bytes = self._pool_k.nbytes + self._pool_v.nbytes
+        self._pool_ks = pools.get("k_scale")
+        self._pool_vs = pools.get("v_scale")
         logger.info(
             f"serving: paged KV pool {cfg.num_kv_blocks} x "
             f"{self.block_size}-token blocks "
-            f"({kv_bytes / 2**20:.1f} MiB), {self.num_slots} decode "
+            f"({self.kv_pool_bytes / 2**20:.1f} MiB"
+            f"{f', int{self.kv_bits} + f32 scales' if self.kv_bits else ''}"
+            f"), {self.num_slots} decode "
             f"slots, {self.max_pages} pages/seq, prefill chunk "
             f"{self.chunk_tokens} tokens, prefix cache "
             f"{'on' if cfg.prefix_cache else 'off'}")
@@ -144,6 +155,17 @@ class ServingEngine:
         self._m_cached = reg.gauge(
             "dstpu_serving_cached_kv_blocks",
             "refcount-0 pool blocks parked in the prefix-cache LRU")
+        # static pool-footprint gauges (set once: the pool is
+        # preallocated) — the compressed pool must be VISIBLE, not
+        # inferred from config
+        reg.gauge(
+            "dstpu_serving_kv_pool_bytes",
+            "device HBM held by the paged KV pool (values + dequant "
+            "scales)").set(self.kv_pool_bytes)
+        reg.gauge(
+            "dstpu_serving_kv_bits",
+            "KV-cache width: 0 = engine dtype, 8 = int8, 4 = packed "
+            "int4").set(self.kv_bits)
         self._m_ttft = reg.histogram(
             "dstpu_serving_ttft_seconds",
             "submit -> first token (includes queueing + chunked prefill)")
@@ -195,6 +217,16 @@ class ServingEngine:
         # cumulative ints
         self._hits_polled = 0
         self._evictions_polled = 0
+
+    @property
+    def kv_pool_bytes(self) -> int:
+        """Device HBM held by the paged KV pool — values plus the
+        dequant scale planes when quantized (the
+        ``dstpu_serving_kv_pool_bytes`` gauge)."""
+        total = self._pool_k.nbytes + self._pool_v.nbytes
+        if self._pool_ks is not None:
+            total += self._pool_ks.nbytes + self._pool_vs.nbytes
+        return total
 
     # ------------------------------------------------------------------
     # request intake
@@ -271,14 +303,15 @@ class ServingEngine:
     def _build_step(self):
         engine, model = self.engine, self.model
 
-        def step(params, scales, pool_k, pool_v, tables, lens,
-                 dec_tokens, dec_active, chunk_ids, chunk_slot,
-                 chunk_start, chunk_len, rng):
+        def step(params, scales, pool_k, pool_v, pool_ks, pool_vs,
+                 tables, lens, dec_tokens, dec_active, chunk_ids,
+                 chunk_slot, chunk_start, chunk_len, rng):
             # trace-time side effect: counts program BUILDS, not calls —
             # continuous batching must never retrace this
             self.decode_builds += 1
             mp = engine._model_params(params, scales)
-            cache = {"k": pool_k, "v": pool_v, "block_tables": tables,
+            cache = {"k": pool_k, "v": pool_v, "k_scale": pool_ks,
+                     "v_scale": pool_vs, "block_tables": tables,
                      "lens": lens}
             dec_logits, chunk_logits, cache = model._apply_paged_mixed(
                 mp, cache, dec_tokens, dec_active, chunk_ids, chunk_slot,
@@ -297,12 +330,16 @@ class ServingEngine:
             dec_finite = jnp.all(jnp.isfinite(dec_logits), axis=-1)
             chunk_finite = jnp.all(jnp.isfinite(chunk_logits))
             return (nxt.astype(jnp.int32), first.astype(jnp.int32),
-                    dec_finite, chunk_finite, cache["k"], cache["v"], rng)
+                    dec_finite, chunk_finite, cache["k"], cache["v"],
+                    cache.get("k_scale"), cache.get("v_scale"), rng)
 
         get_registry().counter("dstpu_jit_programs_built_total").inc()
+        # the quantized pool's scale planes are donated with it (they
+        # are rewritten at every scatter, exactly like the values)
+        donate = (2, 3, 4, 5) if self.kv_bits else (2, 3)
         with self.engine.mesh:
             return jax.jit(
-                step, donate_argnums=(2, 3) if self._donate else ())
+                step, donate_argnums=donate if self._donate else ())
 
     # ------------------------------------------------------------------
     # one scheduler iteration
@@ -373,10 +410,11 @@ class ServingEngine:
                     trace_span("serving/prefill_chunk", slot=c_slot,
                                start=c_start, tokens=c_len))
             (nxt, first, dec_fin, chunk_fin, self._pool_k, self._pool_v,
-             self._rng) = self._step_fn(
+             self._pool_ks, self._pool_vs, self._rng) = self._step_fn(
                 self.engine.params,
                 getattr(self.engine, "_scales", None),
-                self._pool_k, self._pool_v, tables, lens, dec_tokens,
+                self._pool_k, self._pool_v, self._pool_ks,
+                self._pool_vs, tables, lens, dec_tokens,
                 dec_active, chunk_ids,
                 jnp.asarray(c_slot, jnp.int32),
                 jnp.asarray(c_start, jnp.int32),

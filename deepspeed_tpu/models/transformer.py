@@ -39,17 +39,25 @@ class PagedKVCache(NamedTuple):
     the marker type ``_attention`` dispatches on for the
     continuous-batching decode path (``inference/serving/``).
 
-      k_pool / v_pool  [num_blocks, block, kv_heads, head_dim]
+      k_pool / v_pool  [num_blocks, block, kv_heads, head_dim] — or,
+                       with a quantized KV cache
+                       (``serving.kv_cache_bits``), int8 pools at
+                       ``head_dim`` (8-bit) / ``head_dim // 2``
+                       (packed 4-bit) width
       block_tables     [B, pages] int32 (pool block ids; tail entries
                        hold the reserved null block 0)
       lens             [B] int32 — tokens ALREADY in the cache per slot
                        (the new token writes at position ``lens``;
                        0 = inactive slot)
+      k_scale / v_scale  [num_blocks, block, kv_heads] f32 per-row
+                       per-head dequant scales (None = bf16/f32 pools)
     """
     k_pool: Any
     v_pool: Any
     block_tables: Any
     lens: Any
+    k_scale: Any = None
+    v_scale: Any = None
 
 
 class PagedMixedState(NamedTuple):
@@ -70,6 +78,8 @@ class PagedMixedState(NamedTuple):
                    token (== rows already present for that slot)
       chunk_len    int32 scalar — valid chunk tokens (0 = no prefill
                    work this dispatch)
+      k_scale / v_scale  per-row per-head dequant scales (see
+                   :class:`PagedKVCache`; None = unquantized pools)
     """
     k_pool: Any
     v_pool: Any
@@ -79,6 +89,8 @@ class PagedMixedState(NamedTuple):
     chunk_slot: Any
     chunk_start: Any
     chunk_len: Any
+    k_scale: Any = None
+    v_scale: Any = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -724,32 +736,58 @@ class TransformerLM:
         the write indices never collide; inactive slots write into the
         reserved null block 0), then the batched Pallas kernel attends
         over the block tables with per-slot lengths — no per-step cache
-        copy, no ``jnp.pad``."""
+        copy, no ``jnp.pad``.  With a quantized pool
+        (``paged.k_scale is not None``) the new rows are encoded at the
+        scatter (``ops/quantizer/kv_quantize`` — one scale per row per
+        kv head, written alongside) and the kernel dequantizes in its
+        inner loop, so the pool never holds a full-precision copy."""
         if t != 1:
             raise NotImplementedError(
                 f"paged decode is token-at-a-time (t=1), got t={t} — "
                 f"prompts prefill through the dense cache path")
-        pool_k, pool_v, tables, lens = paged
+        pool_k, pool_v = paged.k_pool, paged.v_pool
+        tables, lens = paged.block_tables, paged.lens
+        kscale, vscale = paged.k_scale, paged.v_scale
+        kv_bits = self._paged_kv_bits(pool_k, kscale, hd)
         nb, blk = pool_k.shape[0], pool_k.shape[1]
         slot = jnp.arange(b)
         # write position of the new token: block_table[len // blk]
         # offset len % blk, flattened over [nb * blk] rows
         write = tables[slot, lens // blk] * blk + lens % blk
         flat = (nb * blk,) + pool_k.shape[2:]
-        pool_k = pool_k.reshape(flat).at[write].set(
-            k[:, 0].astype(pool_k.dtype)).reshape(pool_k.shape)
-        pool_v = pool_v.reshape(flat).at[write].set(
-            v[:, 0].astype(pool_v.dtype)).reshape(pool_v.shape)
+        if kv_bits:
+            from ..ops.quantizer.quantizer import kv_quantize
+            kq, ks = kv_quantize(k[:, 0], kv_bits)    # [B,kvh,De],[B,kvh]
+            vq, vs = kv_quantize(v[:, 0], kv_bits)
+            sflat = (nb * blk,) + kscale.shape[2:]
+            pool_k = pool_k.reshape(flat).at[write].set(
+                kq).reshape(pool_k.shape)
+            pool_v = pool_v.reshape(flat).at[write].set(
+                vq).reshape(pool_v.shape)
+            kscale = kscale.reshape(sflat).at[write].set(
+                ks).reshape(paged.k_scale.shape)
+            vscale = vscale.reshape(sflat).at[write].set(
+                vs).reshape(paged.v_scale.shape)
+            kern_k, kern_v = pool_k, pool_v
+        else:
+            pool_k = pool_k.reshape(flat).at[write].set(
+                k[:, 0].astype(pool_k.dtype)).reshape(pool_k.shape)
+            pool_v = pool_v.reshape(flat).at[write].set(
+                v[:, 0].astype(pool_v.dtype)).reshape(pool_v.shape)
+            kern_k, kern_v = pool_k.astype(q.dtype), pool_v.astype(q.dtype)
         from ..ops.transformer.paged_decode_attention import (
             paged_decode_attention)
         o = paged_decode_attention(
-            q[:, 0], pool_k.astype(q.dtype), pool_v.astype(q.dtype),
+            q[:, 0], kern_k, kern_v,
             # inactive slots (lens 0) must stay 0 so the kernel's
             # null-block page is masked off, not attended
             jnp.where(lens > 0, lens + 1, 0), tables,
-            sm_scale=self._attn_scale)
+            sm_scale=self._attn_scale,
+            k_scale=kscale, v_scale=vscale, kv_bits=kv_bits)
         o = o.reshape(b, t, nh * hd)
-        return L.dense_apply(p["out"], o), (pool_k, pool_v)
+        pools = (pool_k, pool_v) if not kv_bits else \
+            (pool_k, pool_v, kscale, vscale)
+        return L.dense_apply(p["out"], o), pools
 
     def _paged_mixed_attention(self, p, q, k, v, st: PagedMixedState, t,
                                nh, hd):
@@ -764,9 +802,14 @@ class TransformerLM:
         rows re-route to the reserved null block), then two kernels
         attend — the batched decode kernel over all slots and the
         causal chunk kernel over the chunk slot's pages — and the
-        outputs concatenate back into the shared projection."""
+        outputs concatenate back into the shared projection.  A
+        quantized pool (``st.k_scale is not None``) encodes all B + C
+        rows at the combined scatter and both kernels dequantize
+        in-loop (see :meth:`_paged_attention`)."""
         pool_k, pool_v, tables, lens = (st.k_pool, st.v_pool,
                                         st.block_tables, st.lens)
+        kscale, vscale = st.k_scale, st.v_scale
+        kv_bits = self._paged_kv_bits(pool_k, kscale, hd)
         bsl = lens.shape[0]                   # decode slots
         c = t - bsl                           # chunk width
         nb, blk = pool_k.shape[0], pool_k.shape[1]
@@ -787,27 +830,46 @@ class TransformerLM:
                        0)
         write = jnp.concatenate([wd, wc])
         flat = (nb * blk,) + pool_k.shape[2:]
-        pool_k = pool_k.reshape(flat).at[write].set(
-            k[0].astype(pool_k.dtype)).reshape(pool_k.shape)
-        pool_v = pool_v.reshape(flat).at[write].set(
-            v[0].astype(pool_v.dtype)).reshape(pool_v.shape)
+        if kv_bits:
+            from ..ops.quantizer.quantizer import kv_quantize
+            kq, ks = kv_quantize(k[0], kv_bits)   # [B+C,kvh,De],[B+C,kvh]
+            vq, vs = kv_quantize(v[0], kv_bits)
+            sflat = (nb * blk,) + kscale.shape[2:]
+            pool_k = pool_k.reshape(flat).at[write].set(
+                kq).reshape(pool_k.shape)
+            pool_v = pool_v.reshape(flat).at[write].set(
+                vq).reshape(pool_v.shape)
+            kscale = kscale.reshape(sflat).at[write].set(
+                ks).reshape(st.k_scale.shape)
+            vscale = vscale.reshape(sflat).at[write].set(
+                vs).reshape(st.v_scale.shape)
+            pk, pv = pool_k, pool_v
+        else:
+            pool_k = pool_k.reshape(flat).at[write].set(
+                k[0].astype(pool_k.dtype)).reshape(pool_k.shape)
+            pool_v = pool_v.reshape(flat).at[write].set(
+                v[0].astype(pool_v.dtype)).reshape(pool_v.shape)
+            pk = pool_k.astype(q.dtype)
+            pv = pool_v.astype(q.dtype)
         from ..ops.transformer.paged_decode_attention import (
             paged_decode_attention, paged_prefill_attention)
-        pk = pool_k.astype(q.dtype)
-        pv = pool_v.astype(q.dtype)
         o_dec = paged_decode_attention(
             q[0, :bsl], pk, pv,
             # only slots decoding THIS iteration attend (their length
             # includes the just-written token); prefilling and empty
             # slots are masked to zero rows
             jnp.where(act, lens + 1, 0), tables,
-            sm_scale=self._attn_scale)
+            sm_scale=self._attn_scale,
+            k_scale=kscale, v_scale=vscale, kv_bits=kv_bits)
         o_chunk = paged_prefill_attention(
             q[0, bsl:], pk, pv, st.chunk_start, st.chunk_len, ctable,
-            sm_scale=self._attn_scale)
+            sm_scale=self._attn_scale,
+            k_scale=kscale, v_scale=vscale, kv_bits=kv_bits)
         o = jnp.concatenate([o_dec, o_chunk], axis=0)[None]
         o = o.reshape(1, t, nh * hd)
-        return L.dense_apply(p["out"], o), (pool_k, pool_v)
+        pools = (pool_k, pool_v) if not kv_bits else \
+            (pool_k, pool_v, kscale, vscale)
+        return L.dense_apply(p["out"], o), pools
 
     def _mlp(self, p, x):
         xq = self._maybe_qact(x, "mlp_in")
@@ -1084,11 +1146,22 @@ class TransformerLM:
             return f"head_dim {c.hdim} is not lane-aligned (multiple of 8)"
         return None
 
+    @staticmethod
+    def _paged_kv_bits(pool_k, k_scale, hd: int) -> int:
+        """Static kv-cache width from the pool's (trace-time) shape: 0
+        when unquantized, else 8 (int8 at full head_dim) or 4 (packed
+        nibbles at head_dim // 2)."""
+        if k_scale is None:
+            return 0
+        return 8 if pool_k.shape[-1] == hd else 4
+
     def _apply_paged_decode(self, params, input_ids, cache):
         """Continuous-batching decode step: one new token per slot
         against the paged KV pool.
 
-        ``cache``: {"k"/"v": [L, num_blocks, block, kv_heads, hd] pools,
+        ``cache``: {"k"/"v": [L, num_blocks, block, kv_heads, hd] pools
+        (int8 at hd | hd//2 width plus "k_scale"/"v_scale"
+        [L, num_blocks, block, kv_heads] f32 when quantized),
         "block_tables": [B, pages] int32, "lens": [B] int32 (tokens
         already cached per slot; 0 = inactive)}.  Returns
         ``(logits [B, 1, V], cache with updated pools and lens + 1)``.
@@ -1101,23 +1174,28 @@ class TransformerLM:
             raise NotImplementedError(
                 "paged decode consumes one token per slot per step")
         tables, lens = cache["block_tables"], cache["lens"]
+        quant = cache.get("k_scale") is not None
         positions = lens[:, None]          # each slot decodes at its own pos
         x = self._embed_tokens(params, input_ids, positions=positions)
 
         def scan_fn(carry, xs):
-            bp, pk, pv = xs
+            bp, *pools = xs
             bp = self.block_transform(bp)
-            y, (npk, npv) = self._block(
-                bp, carry, PagedKVCache(pk, pv, tables, lens), positions)
-            return y, (npk, npv)
+            y, new_pools = self._block(
+                bp, carry, PagedKVCache(*pools[:2], tables, lens,
+                                        *pools[2:]), positions)
+            return y, new_pools
 
-        x, (nk, nv) = jax.lax.scan(scan_fn, x,
-                                   (params["blocks"], cache["k"],
-                                    cache["v"]))
+        xs = (params["blocks"], cache["k"], cache["v"])
+        if quant:
+            xs += (cache["k_scale"], cache["v_scale"])
+        x, pools = jax.lax.scan(scan_fn, x, xs)
         if self.config.final_layernorm:
             x = self._norm_fn()(params["ln_f"], x)
-        new_cache = {"k": nk, "v": nv, "block_tables": tables,
+        new_cache = {"k": pools[0], "v": pools[1], "block_tables": tables,
                      "lens": jnp.where(lens > 0, lens + 1, 0)}
+        if quant:
+            new_cache["k_scale"], new_cache["v_scale"] = pools[2], pools[3]
         return self._project(params, x), new_cache
 
     def _apply_paged_mixed(self, params, cache, dec_tokens, dec_active,
@@ -1140,6 +1218,7 @@ class TransformerLM:
         if reason is not None:
             raise NotImplementedError(reason)
         tables, lens = cache["block_tables"], cache["lens"]
+        quant = cache.get("k_scale") is not None
         bsl = dec_tokens.shape[0]
         c = chunk_ids.shape[0]
         ci = jnp.arange(c)
@@ -1153,15 +1232,17 @@ class TransformerLM:
                    chunk_len)
 
         def scan_fn(carry, xs):
-            bp, pk, pv = xs
+            bp, *pools = xs
             bp = self.block_transform(bp)
-            y, (npk, npv) = self._block(
-                bp, carry, PagedMixedState(pk, pv, *st_args), positions)
-            return y, (npk, npv)
+            y, new_pools = self._block(
+                bp, carry, PagedMixedState(*pools[:2], *st_args,
+                                           *pools[2:]), positions)
+            return y, new_pools
 
-        x, (nk, nv) = jax.lax.scan(scan_fn, x,
-                                   (params["blocks"], cache["k"],
-                                    cache["v"]))
+        xs = (params["blocks"], cache["k"], cache["v"])
+        if quant:
+            xs += (cache["k_scale"], cache["v_scale"])
+        x, pools = jax.lax.scan(scan_fn, x, xs)
         if self.config.final_layernorm:
             x = self._norm_fn()(params["ln_f"], x)
         # project only the rows anything samples from: the B decode rows
@@ -1173,22 +1254,44 @@ class TransformerLM:
                                jnp.concatenate([x[0, :bsl], last])[None])
         new_lens = lens + (dec_active > 0).astype(lens.dtype)
         new_lens = new_lens.at[chunk_slot].add(chunk_len)
-        new_cache = {"k": nk, "v": nv, "block_tables": tables,
+        new_cache = {"k": pools[0], "v": pools[1], "block_tables": tables,
                      "lens": new_lens}
+        if quant:
+            new_cache["k_scale"], new_cache["v_scale"] = pools[2], pools[3]
         return logits[0, :bsl], logits[0, bsl], new_cache
 
     def init_paged_cache(self, num_blocks: int, block_size: int,
-                         dtype=None) -> Dict:
+                         dtype=None, kv_bits: int = 0) -> Dict:
         """Preallocated paged KV pool for continuous-batching serving:
         ``num_blocks`` fixed-size blocks of ``block_size`` tokens shared
         by every sequence through per-slot block tables (block 0 is the
         allocator's reserved null block).  Pools are per layer; tables
-        and lens start empty — the serving engine owns them."""
+        and lens start empty — the serving engine owns them.
+
+        ``kv_bits`` 8 or 4 stores the pool COMPRESSED: int8 values at
+        head_dim (8-bit) or packed-nibble head_dim // 2 (4-bit) width,
+        with per-row per-head f32 scales in ``k_scale``/``v_scale`` —
+        2x / ~3.8x more tokens per HBM byte, and the attention kernels
+        dequantize in their inner loop (``serving.kv_cache_bits``)."""
         reason = self._paged_supported()
         if reason is not None:
             raise NotImplementedError(reason)
         c = self.config
         dtype = dtype or c.dtype
+        if kv_bits not in (0, 4, 8):
+            raise ValueError(f"kv_bits must be 0, 4 or 8, got {kv_bits}")
+        if kv_bits == 4 and c.hdim % 2:
+            raise ValueError(
+                f"packed int4 KV needs an even head_dim, got {c.hdim}")
+        if kv_bits:
+            d_eff = c.hdim if kv_bits == 8 else c.hdim // 2
+            shape = (c.num_layers, num_blocks, block_size, c.kv_heads,
+                     d_eff)
+            sshape = shape[:-1]
+            return {"k": jnp.zeros(shape, jnp.int8),
+                    "v": jnp.zeros(shape, jnp.int8),
+                    "k_scale": jnp.zeros(sshape, jnp.float32),
+                    "v_scale": jnp.zeros(sshape, jnp.float32)}
         shape = (c.num_layers, num_blocks, block_size, c.kv_heads, c.hdim)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 

@@ -1,5 +1,7 @@
 """Quantization ops — counterpart of `/root/reference/csrc/quantization/`."""
-from .quantizer import (dequantize, fake_quantize, quantization_error,
-                        quantize)
+from .quantizer import (dequantize, fake_quantize, kv_dequantize,
+                        kv_quantize, pack_int4, quantization_error,
+                        quantize, unpack_int4)
 
-__all__ = ["quantize", "dequantize", "fake_quantize", "quantization_error"]
+__all__ = ["quantize", "dequantize", "fake_quantize", "quantization_error",
+           "pack_int4", "unpack_int4", "kv_quantize", "kv_dequantize"]

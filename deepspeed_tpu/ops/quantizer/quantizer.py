@@ -26,21 +26,56 @@ def _grouped(x: jnp.ndarray, num_groups: int) -> Tuple[jnp.ndarray, tuple]:
     return flat, shape
 
 
+def pack_int4(q: jnp.ndarray) -> jnp.ndarray:
+    """Pack signed int4 values (int dtype, range [-8, 7]) two-per-int8
+    along the LAST axis: element ``2j`` lands in the low nibble of byte
+    ``j``, element ``2j+1`` in the high nibble.  An odd trailing size is
+    padded with a zero nibble (``unpack_int4`` drops it — the round
+    trip is shape-preserving given the original size)."""
+    n = q.shape[-1]
+    q = q.astype(jnp.int32)
+    if n % 2:
+        q = jnp.concatenate(
+            [q, jnp.zeros(q.shape[:-1] + (1,), jnp.int32)], axis=-1)
+    lo, hi = q[..., 0::2], q[..., 1::2]
+    return ((lo & 0xF) | ((hi & 0xF) << 4)).astype(jnp.int8)
+
+
+def unpack_int4(packed: jnp.ndarray, size: int) -> jnp.ndarray:
+    """Inverse of :func:`pack_int4`: int8 bytes → sign-extended int8
+    values with last axis ``size`` (the original, possibly odd,
+    length)."""
+    x = packed.astype(jnp.int32)
+    lo = ((x & 0xF) ^ 8) - 8          # sign-extend the low nibble
+    hi = x >> 4                        # arithmetic shift: high nibble
+    out = jnp.stack([lo, hi], axis=-1).reshape(
+        packed.shape[:-1] + (2 * packed.shape[-1],))
+    return out[..., :size].astype(jnp.int8)
+
+
 def quantize(x: jnp.ndarray, num_bits: int = 8, num_groups: int = 1,
-             symmetric: bool = True
+             symmetric: bool = True, pack: bool = False
              ) -> Tuple[jnp.ndarray, jnp.ndarray, Optional[jnp.ndarray]]:
     """x → (int values, scale [G,1], zero_point [G,1] | None).
 
     Symmetric: q = round(x / scale), scale = max|x| / qmax.
-    Asymmetric: q = round((x - min) / scale), range [0, 2^bits - 1]."""
+    Asymmetric: q = round((x - min) / scale), range [0, 2^bits - 1].
+    ``pack=True`` (symmetric int4 only) returns the values packed two
+    nibbles per int8 along the group axis (:func:`pack_int4`) — half
+    the bytes, same information; :func:`dequantize` unpacks given
+    ``num_bits=4, packed=True``."""
+    if pack and (num_bits != 4 or not symmetric):
+        raise ValueError(
+            f"pack=True is the symmetric int4 path, got num_bits="
+            f"{num_bits} symmetric={symmetric}")
     flat, _ = _grouped(x.astype(jnp.float32), num_groups)
     if symmetric:
         qmax = 2.0 ** (num_bits - 1) - 1
         scale = jnp.max(jnp.abs(flat), axis=1, keepdims=True) / qmax
         scale = jnp.maximum(scale, 1e-12)
         q = jnp.clip(jnp.round(flat / scale), -qmax - 1, qmax)
-        return q.astype(jnp.int8 if num_bits <= 8 else jnp.int32), \
-            scale, None
+        q = q.astype(jnp.int8 if num_bits <= 8 else jnp.int32)
+        return (pack_int4(q) if pack else q), scale, None
     qmax = 2.0 ** num_bits - 1
     lo = jnp.min(flat, axis=1, keepdims=True)
     hi = jnp.max(flat, axis=1, keepdims=True)
@@ -51,7 +86,14 @@ def quantize(x: jnp.ndarray, num_bits: int = 8, num_groups: int = 1,
 
 def dequantize(q: jnp.ndarray, scale: jnp.ndarray,
                zero_point: Optional[jnp.ndarray], shape: tuple,
-               dtype=jnp.float32) -> jnp.ndarray:
+               dtype=jnp.float32, packed: bool = False) -> jnp.ndarray:
+    if packed:
+        # group-axis size comes from the target shape: total elements
+        # over the number of groups (the scale rows)
+        size = 1
+        for s in shape:
+            size *= s
+        q = unpack_int4(q, size // q.shape[0])
     flat = q.astype(jnp.float32) * scale
     if zero_point is not None:
         flat = flat + zero_point
@@ -101,6 +143,52 @@ def _fqs_bwd(absmax, num_bits, _res, g):
 
 
 fake_quantize_static.defvjp(_fqs_fwd, _fqs_bwd)
+
+
+def kv_quantize(x: jnp.ndarray, num_bits: int = 8
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-vector quantization in the paged-KV layout: one
+    scale per LEADING index over the last (head_dim) axis — i.e. per
+    token-row per kv head, so a cache row is encoded exactly once when
+    written and never rescaled as its block fills.
+
+    ``x [..., D]`` → ``(values int8 [..., D] (8-bit) | [..., D//2]
+    (packed 4-bit), scale f32 [...])``.  The int4 layout is
+    FEATURE-SPLIT, not pairwise: byte ``j`` holds feature ``j`` in the
+    low nibble and feature ``j + D//2`` in the high nibble, so the
+    fused-dequant kernel reconstructs the row with one lane
+    concatenation of the sign-extended halves (``kv_dequantize``
+    mirrors it and is the jnp reference the kernels are parity-pinned
+    against)."""
+    if num_bits not in (4, 8):
+        raise ValueError(f"kv cache bits must be 4 or 8, got {num_bits}")
+    d = x.shape[-1]
+    if num_bits == 4 and d % 2:
+        raise ValueError(f"packed int4 KV needs an even head_dim, got {d}")
+    qmax = 2.0 ** (num_bits - 1) - 1
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1) / qmax, 1e-8)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -qmax - 1, qmax)
+    q = q.astype(jnp.int32)
+    if num_bits == 4:
+        lo, hi = q[..., :d // 2], q[..., d // 2:]
+        q = (lo & 0xF) | ((hi & 0xF) << 4)
+    return q.astype(jnp.int8), scale
+
+
+def kv_dequantize(q: jnp.ndarray, scale: jnp.ndarray, num_bits: int = 8,
+                  dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of :func:`kv_quantize` — and, verbatim, the dequant math
+    the paged-attention kernels fuse into their inner loop (int math +
+    one multiply; parity tests pin the kernels against this)."""
+    x = q.astype(jnp.int32)
+    if num_bits == 4:
+        lo = ((x & 0xF) ^ 8) - 8
+        hi = x >> 4
+        x = jnp.concatenate([lo, hi], axis=-1)
+    elif num_bits != 8:
+        raise ValueError(f"kv cache bits must be 4 or 8, got {num_bits}")
+    return (x.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
 def quantization_error(x: jnp.ndarray, num_bits: int = 8,

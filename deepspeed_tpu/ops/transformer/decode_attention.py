@@ -13,10 +13,18 @@ The r4 kernel wanted ``[B*H, S, D]``, which forced a full materialized
 transpose of the cache per decode step (2x the cache size in extra HBM
 traffic) and left the kernel itself reading 256-byte strided rows.
 
-Online softmax runs per head with state in ``[1, H]`` row orientation;
-row-scaling of the ``[H, D]`` accumulator by a ``[1, H]`` vector is done
-as a ``diag(alpha) @ acc`` matmul (a 16x16 MXU op) — Mosaic has no cheap
-[1,H]->[H,1] relayout, and this keeps the kernel transpose-free.
+Round 8 (the roofline rework, ISSUE 8): the r5 compute was a VPU
+elementwise multiply plus a cross-LANE reduction over the head_dim axis
+for every one of ``chunk * H`` score rows — far slower than the slab
+DMA it was supposed to hide — and accumulator rescaling went through a
+``diag(alpha) @ acc`` matmul because the ``[1, H]`` state orientation
+could not broadcast.  Scores are now one batched-over-heads
+``[1, D] x [D, chunk]`` MXU contraction per slab (``dot_general`` with
+H as a batch dim) producing ``[H, chunk]``, softmax state lives as
+``[H, 1]`` sublane vectors whose broadcast over lanes is free, and the
+weighted-value accumulation is the mirrored ``[1, chunk] x [chunk, D]``
+contraction — no lane reductions, no diag trick, nothing between the
+DMA engine and the roofline but the online-softmax recurrence.
 
 The valid length arrives as a scalar-prefetch operand (SMEM), so one
 compiled kernel serves every decode position.
@@ -45,25 +53,14 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _rowscale(vec_1h, mat_hd):
-    """Scale row h of ``mat_hd`` [H, D] by ``vec_1h`` [1, H]: build
-    diag(vec) with 2-D iotas and contract on the MXU — no relayout."""
-    h = mat_hd.shape[0]
-    r = jax.lax.broadcasted_iota(jnp.int32, (h, h), 0)
-    c = jax.lax.broadcasted_iota(jnp.int32, (h, h), 1)
-    diag = jnp.where(r == c, jnp.broadcast_to(vec_1h, (h, h)), 0.0)
-    # HIGHEST: default matmul precision truncates f32 operands to bf16
-    # passes, which would put a bf16 round on every accumulator rescale
-    return jnp.dot(diag, mat_hd, preferred_element_type=jnp.float32,
-                   precision=jax.lax.Precision.HIGHEST)
-
-
 def _kernel_heads(len_ref, q_ref, k_ref, v_ref, o_ref,
                   m_scr, l_scr, acc_scr, *, sm_scale, chunk):
     """Online-softmax decode over KV chunks, ALL heads per chunk.
 
     q_ref [H, D]; k_ref/v_ref [chunk, H, D] (contiguous HBM slab);
-    o_ref [H, D]; scratch: m/l [1, H], acc [H, D]."""
+    o_ref [H, D]; scratch: m/l [H, 1], acc [H, D] — the [H, 1] sublane
+    orientation broadcasts over the lane dim for free, so accumulator
+    rescaling is a plain multiply."""
     c = pl.program_id(1)
     nc = pl.num_programs(1)
 
@@ -79,16 +76,20 @@ def _kernel_heads(len_ref, q_ref, k_ref, v_ref, o_ref,
     def _body():
         q = q_ref[...].astype(jnp.float32)            # [H, D]
         k = k_ref[...].astype(jnp.float32)            # [chunk, H, D]
-        scores = jnp.sum(k * q[None], axis=-1) * sm_scale    # [chunk, H]
+        # batched-over-heads [1, D] x [D, chunk] matvec on the MXU (the
+        # r5 VPU multiply + lane-reduce was the kernel's 16x headroom)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * sm_scale    # [H, chunk]
         pos = c * chunk + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 0)
+            jnp.int32, scores.shape, 1)
         scores = jnp.where(pos < len_ref[0], scores, MASK_VALUE)
-        m_prev = m_scr[...]                           # [1, H]
+        m_prev = m_scr[...]                           # [H, 1]
         m_new = jnp.maximum(m_prev,
-                            jnp.max(scores, axis=0, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)               # [1, H]
-        p = jnp.exp(scores - m_new)                   # [chunk, H]
-        l_scr[...] = alpha * l_scr[...] + jnp.sum(p, axis=0,
+                            jnp.max(scores, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)               # [H, 1]
+        p = jnp.exp(scores - m_new)                   # [H, chunk]
+        l_scr[...] = alpha * l_scr[...] + jnp.sum(p, axis=1,
                                                   keepdims=True)
         v = v_ref[...].astype(jnp.float32)            # [chunk, H, D]
         # masked rows get probability ~0, but 0 * NaN = NaN: zero the v
@@ -97,15 +98,19 @@ def _kernel_heads(len_ref, q_ref, k_ref, v_ref, o_ref,
         # poisons out-of-bounds rows in interpret mode, so any masked
         # row must tolerate ANY content (same convention as the paged
         # kernels since the PR 6 quarantine-block leak)
-        v = jnp.where((pos < len_ref[0])[..., None], v, 0.0)
-        pv = jnp.sum(p[:, :, None] * v, axis=0)       # [H, D]
-        acc_scr[...] = _rowscale(alpha, acc_scr[...]) + pv
+        rowpos = c * chunk + jax.lax.broadcasted_iota(
+            jnp.int32, (k.shape[0], 1), 0)
+        v = jnp.where(rowpos[..., None] < len_ref[0], v, 0.0)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)       # [H, D]
+        acc_scr[...] = alpha * acc_scr[...] + pv
         m_scr[...] = m_new
 
     @pl.when(c == nc - 1)
     def _out():
-        inv = 1.0 / jnp.maximum(l_scr[...], 1e-30)    # [1, H]
-        o_ref[...] = _rowscale(inv, acc_scr[...]).astype(o_ref.dtype)
+        inv = 1.0 / jnp.maximum(l_scr[...], 1e-30)    # [H, 1]
+        o_ref[...] = (inv * acc_scr[...]).astype(o_ref.dtype)
 
 
 # [chunk, H, D] slabs: 2 operands x bf16 x double-buffering + f32
@@ -161,8 +166,8 @@ def decode_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             out_specs=pl.BlockSpec((None, h, d),
                                    lambda i, c, *_: (i, 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((1, h), jnp.float32),
-                pltpu.VMEM((1, h), jnp.float32),
+                pltpu.VMEM((h, 1), jnp.float32),
+                pltpu.VMEM((h, 1), jnp.float32),
                 pltpu.VMEM((h, d), jnp.float32),
             ],
         ),

@@ -1,4 +1,4 @@
-"""Batched paged decode attention — Pallas TPU kernel for serving.
+"""Batched paged attention — roofline Pallas TPU kernels for serving.
 
 The multi-sequence extension of ``decode_attention.py``: that kernel
 serves ONE ragged dimension (a single shared ``length`` scalar) and
@@ -9,30 +9,50 @@ fixed-size blocks of a shared pool indexed through per-sequence block
 tables (PagedAttention, Kwon et al. SOSP '23; `inference/serving/`
 builds the allocator).
 
-Kernel design:
+Kernel design (v2 — the v1 one-page-per-program ``(slot, page)`` grid
+measured 7.4 GB/s against a ~119 GB/s HBM ceiling, BENCH_ALL_r04):
 
-  * grid ``(slot, page)`` — one decode slot per batch row, one KV block
-    ("page") per inner step; ``dimension_semantics=("parallel",
-    "arbitrary")`` so slots spread across cores while the page walk
-    stays sequential for the online-softmax accumulator.
-  * the per-slot valid length and the ``[slots, pages]`` block table are
-    scalar-prefetch operands: the page BlockSpec index_map reads
-    ``table[slot, page]`` so only the blocks a slot actually owns are
-    ever DMA'd.  Pages past a slot's length re-map to the slot's LAST
-    valid block — Pallas skips the copy when the block index does not
-    change, so a short sequence in a long-batch grid costs no extra HBM
-    traffic (the ``jnp.pad`` full-cache copy the dense batched fallback
-    would take simply has no equivalent here).
-  * inactive slots (length 0) map to pool block 0 — the allocator's
-    reserved null block — and produce all-zero output rows.
-  * GQA: the pool stores ``kv_heads`` heads; query heads fold into
-    ``[kv_heads, group]`` inside the kernel so grouped models pay
-    kv-width HBM traffic (the reason GQA exists) without a repeated-KV
+  * grid ``(slot, kv_head, page_group)`` with MULTIPLE pages per
+    program: each step consumes ``pages_per_program`` KV blocks, so the
+    per-step compute is wide enough to hide grid overhead and the DMA
+    engine sees big batched transfers instead of one small block per
+    step.
+  * DOUBLE-BUFFERED manual block fetches: the pools stay in HBM
+    (``memory_space=ANY``) and the kernel issues its own async copies —
+    while page group *g* is being consumed, group *g+1* is already in
+    flight into the other half of the VMEM scratch.  The fetch for the
+    texture-next grid position (next group, next head, next slot) is
+    issued before the current wait, so the pipeline never drains at a
+    head or slot boundary.  Pages past a slot's valid prefix are simply
+    never fetched (their DMA is predicated off), so the ragged tail of
+    a short sequence costs no HBM traffic at all.
+  * WIDE-LANE compute on the MXU: scores are a ``[G, D] x [D, T]``
+    batched matvec (``T = pages_per_program * block`` rows per step)
+    and the online-softmax state lives as ``[G, 1]`` sublane vectors
+    that broadcast over lanes — no per-element lane reductions, no
+    diag-matmul rescaling tricks.
+  * FUSED DEQUANT: the pool can hold int8 or packed-int4 KV with one
+    f32 scale per (row, kv head) stored alongside
+    (``ops/quantizer/kv_quantize`` is the encode, and its
+    ``kv_dequantize`` is the bit-exact jnp mirror of the in-kernel
+    decode).  Compressed bytes are what crosses HBM; the kernel widens
+    to f32 only inside VMEM.  int4 is feature-split packed: byte ``j``
+    holds feature ``j`` (low nibble) and ``j + D//2`` (high nibble), so
+    dequant is int math plus one lane concatenation.
+  * GQA: the pool stores ``kv_heads`` heads; the grid walks kv heads
+    and each program serves that head's whole query group at kv-width
+    HBM traffic (the reason GQA exists) without a repeated-KV
     materialization.
+  * inactive slots (length 0) fetch nothing and produce all-zero output
+    rows; masked v rows are ZEROED, not just down-weighted — ``0 x NaN``
+    from a recycled quarantined block must never reach the accumulator
+    (the PR 6 invariant, pinned by the NaN-garbage parity tests).
 
 Layout contract: q ``[B, H, D]`` (one new token per slot), pool k/v
-``[num_blocks, block, Hkv, D]``, lengths ``[B]`` int32 (valid cache
-prefix per slot, INCLUDING the just-written token; 0 = inactive slot),
+``[num_blocks, block, Hkv, D]`` (bf16/f32) or ``[..., D]`` int8 /
+``[..., D//2]`` packed int4 with ``k_scale``/``v_scale``
+``[num_blocks, block, Hkv]`` f32; lengths ``[B]`` int32 (valid cache
+prefix per slot, INCLUDING the just-written token; 0 = inactive slot);
 block_tables ``[B, pages]`` int32.  Returns ``[B, H, D]``.
 """
 from __future__ import annotations
@@ -48,88 +68,229 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..pallas_compat import compiler_params
 
-from .decode_attention import MASK_VALUE, _interpret_default, _rowscale
+from .decode_attention import MASK_VALUE, _interpret_default
+
+#: rows per page group the auto-tuner aims for: enough MXU work per
+#: step to hide grid overhead, small enough that the double-buffered
+#: k/v scratch stays a modest slice of VMEM
+_TARGET_GROUP_ROWS = 1024
+#: cap on concurrently in-flight page DMAs per buffer half
+_MAX_PAGES_PER_PROGRAM = 16
 
 
-def _kernel(len_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
-            m_scr, l_scr, acc_scr, *, sm_scale, block, groups):
-    """Online-softmax walk over one slot's pages, all heads per page.
+def _pages_per_program(block: int, npages: int,
+                       override: Optional[int]) -> int:
+    if override is not None:
+        if override < 1:
+            raise ValueError(
+                f"pages_per_program must be >= 1, got {override}")
+        return min(override, npages)
+    pp = max(1, _TARGET_GROUP_ROWS // block)
+    return max(1, min(pp, _MAX_PAGES_PER_PROGRAM, npages))
 
-    q_ref [H, D]; k_ref/v_ref [block, Hkv, D] (the page the index_map
-    selected via the block table); o_ref [H, D]; scratch m/l [1, H],
-    acc [H, D]."""
-    p = pl.program_id(1)
-    npages = pl.num_programs(1)
-    length = len_ref[pl.program_id(0)]
 
-    @pl.when(p == 0)
+def _dequant_rows(x, scale, kv_bits):
+    """In-kernel fused dequant: ``x [T, De]`` pool rows (+ ``scale
+    [T]``) → f32 ``[T, D]``.  MUST stay the bit-exact mirror of
+    ``ops/quantizer/kv_dequantize`` — parity tests pin the pair."""
+    if kv_bits == 0:
+        return x.astype(jnp.float32)
+    xi = x.astype(jnp.int32)
+    if kv_bits == 4:
+        lo = ((xi & 0xF) ^ 8) - 8
+        hi = xi >> 4
+        xi = jnp.concatenate([lo, hi], axis=-1)
+    return xi.astype(jnp.float32) * scale[:, None]
+
+
+def _group_copies(hbm_refs, bufs, sem, bt_ref, row_of, length, npages,
+                  block, pp, group, buf):
+    """Async-copy descriptors for one page group: for each valid page
+    ``group * pp + j`` of the owning row, one DMA per operand from pool
+    block ``bt[row, page]`` into slice ``j`` of buffer half ``buf``.
+    Start and wait MUST evaluate the same predicates — both call this.
+    Yields ``(valid, [copies...])`` per page."""
+    for j in range(pp):
+        p = group * pp + j
+        valid = (p < npages) & (p * block < length)
+        pidx = jnp.minimum(p, npages - 1)
+        bid = bt_ref[row_of, pidx] if row_of is not None else bt_ref[pidx]
+        copies = [
+            pltpu.make_async_copy(
+                ref.at[bid],
+                buf_ref.at[buf, pl.ds(j * block, block)],
+                sem.at[buf, op])
+            for op, (ref, buf_ref) in enumerate(zip(hbm_refs, bufs))]
+        yield valid, copies
+
+
+def _start_group(*args):
+    for valid, copies in _group_copies(*args):
+        @pl.when(valid)
+        def _():
+            for c in copies:
+                c.start()
+
+
+def _wait_group(*args):
+    for valid, copies in _group_copies(*args):
+        @pl.when(valid)
+        def _():
+            for c in copies:
+                c.wait()
+
+
+def _decode_kernel(len_ref, bt_ref, q_ref, *refs, sm_scale, block, pp,
+                   kv_bits):
+    """Online-softmax walk over one (slot, kv head)'s page groups.
+
+    q_ref [G, D]; VMEM buffers kbuf/vbuf [2, pp*block, De] in the pool
+    dtype (+ ksbuf/vsbuf [2, pp*block] f32 when quantized); scratch
+    m/l [G, 1], acc [G, D] — all f32; one DMA semaphore per
+    (buffer half, operand)."""
+    nops = 2 if kv_bits == 0 else 4
+    k_hbm, v_hbm = refs[0], refs[1]
+    s_hbm = refs[2:nops]
+    o_ref = refs[nops]
+    kbuf, vbuf = refs[nops + 1], refs[nops + 2]
+    s_bufs = refs[nops + 3:nops + 1 + nops]
+    m_scr, l_scr, acc_scr, sem = refs[nops + 1 + nops:]
+    hbm = (k_hbm, v_hbm) + tuple(s_hbm)
+    bufs = (kbuf, vbuf) + tuple(s_bufs)
+
+    i, hh, g = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nh, ng = pl.num_programs(1), pl.num_programs(2)
+    npages = bt_ref.shape[1]
+    rows = pp * block
+    length = len_ref[i]
+    step = (i * nh + hh) * ng + g
+    buf = jax.lax.rem(step, 2)
+
+    def fetch(row, head, group, into_buf, start):
+        srcs = [r.at[:, :, head] for r in hbm]
+        fn = _start_group if start else _wait_group
+        fn(srcs, bufs, sem, bt_ref, row, len_ref[row], npages, block, pp,
+           group, into_buf)
+
+    @pl.when(step == 0)
+    def _cold_start():
+        fetch(i, hh, g, buf, start=True)
+
+    # issue the NEXT grid position's fetch before waiting on ours: the
+    # pipeline stays full across page-group, head, and slot boundaries
+    g1 = g + 1
+    h1 = hh + g1 // ng
+    i1 = i + h1 // nh
+    g1, h1 = jax.lax.rem(g1, ng), jax.lax.rem(h1, nh)
+
+    @pl.when(i1 < pl.num_programs(0))
+    def _prefetch_next():
+        fetch(i1, h1, g1, jax.lax.rem(step + 1, 2), start=True)
+
+    fetch(i, hh, g, buf, start=False)
+
+    @pl.when(g == 0)
     def _init():
         m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    @pl.when(p * block < length)
+    @pl.when(g * rows < length)
     def _body():
-        q = q_ref[...].astype(jnp.float32)            # [H, D]
-        k = k_ref[...].astype(jnp.float32)            # [block, Hkv, D]
-        h, d = q.shape
-        if groups == 1:
-            scores = jnp.sum(k * q[None], axis=-1)    # [block, H]
-        else:
-            # grouped query heads: q row j*groups+g reads kv head j, so
-            # [Hkv, groups, D] q against [block, Hkv, 1, D] kv broadcasts
-            # to [block, Hkv, groups] and flattens back to [block, H]
-            qg = q.reshape(h // groups, groups, d)
-            scores = jnp.sum(k[:, :, None, :] * qg[None],
-                             axis=-1).reshape(k.shape[0], h)
-        scores = scores * sm_scale
-        pos = p * block + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 0)
+        q = q_ref[...].astype(jnp.float32)            # [G, D]
+        kf = _dequant_rows(kbuf[buf],
+                           s_bufs[0][buf] if kv_bits else None,
+                           kv_bits)                   # [T, D] f32
+        scores = jax.lax.dot_general(
+            q, kf, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale    # [G, T]
+        pos = g * rows + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
         scores = jnp.where(pos < length, scores, MASK_VALUE)
-        m_prev = m_scr[...]                           # [1, H]
+        m_prev = m_scr[...]                           # [G, 1]
         m_new = jnp.maximum(m_prev,
-                            jnp.max(scores, axis=0, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)               # [1, H]
-        probs = jnp.exp(scores - m_new)               # [block, H]
-        l_scr[...] = alpha * l_scr[...] + jnp.sum(probs, axis=0,
+                            jnp.max(scores, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)               # [G, 1]
+        probs = jnp.exp(scores - m_new)               # [G, T]
+        l_scr[...] = alpha * l_scr[...] + jnp.sum(probs, axis=1,
                                                   keepdims=True)
-        v = v_ref[...].astype(jnp.float32)            # [block, Hkv, D]
+        vf = _dequant_rows(vbuf[buf],
+                           s_bufs[1][buf] if kv_bits else None,
+                           kv_bits)                   # [T, D] f32
         # masked rows get probability ~0, but 0 * NaN = NaN: zero the v
         # rows past the valid length so a recycled pool block holding a
         # quarantined request's non-finite KV cannot re-poison its next
-        # owner (masked rows tolerate ANY stale content, not just finite)
-        v = jnp.where((pos[:, :1] < length)[..., None], v, 0.0)
-        if groups == 1:
-            pv = jnp.sum(probs[:, :, None] * v, axis=0)       # [H, D]
-        else:
-            pg = probs.reshape(k.shape[0], h // groups, groups)
-            pv = jnp.sum(pg[..., None] * v[:, :, None, :],
-                         axis=0).reshape(h, d)
-        acc_scr[...] = _rowscale(alpha, acc_scr[...]) + pv
+        # owner — unfetched pages also leave stale garbage in the buffer
+        rowpos = g * rows + jax.lax.broadcasted_iota(
+            jnp.int32, (kf.shape[0], 1), 0)
+        vf = jnp.where(rowpos < length, vf, 0.0)
+        pv = jax.lax.dot_general(
+            probs, vf, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [G, D]
+        acc_scr[...] = alpha * acc_scr[...] + pv
         m_scr[...] = m_new
 
-    @pl.when(p == npages - 1)
+    @pl.when(g == ng - 1)
     def _out():
-        # length-0 (inactive) slots never ran a page: l stays 0 and the
+        # length-0 (inactive) slots never ran a group: l stays 0 and the
         # clamp below turns the row into zeros instead of 0/0
-        inv = 1.0 / jnp.maximum(l_scr[...], 1e-30)    # [1, H]
-        o_ref[...] = _rowscale(inv, acc_scr[...]).astype(o_ref.dtype)
+        inv = 1.0 / jnp.maximum(l_scr[...], 1e-30)    # [G, 1]
+        o_ref[...] = (inv * acc_scr[...]).astype(o_ref.dtype)
+
+
+def _check_quant_args(pool_k, pool_v, k_scale, v_scale, kv_bits, d,
+                      what):
+    if kv_bits not in (0, 4, 8):
+        raise ValueError(f"kv_bits must be 0, 4 or 8, got {kv_bits}")
+    if kv_bits == 0:
+        if k_scale is not None or v_scale is not None:
+            raise ValueError(f"{what}: scales given but kv_bits=0")
+        return pool_k.shape[3]
+    if k_scale is None or v_scale is None:
+        raise ValueError(f"{what}: kv_bits={kv_bits} needs k_scale and "
+                         f"v_scale [num_blocks, block, Hkv] f32")
+    if pool_k.dtype != jnp.int8:
+        raise ValueError(
+            f"{what}: quantized pool must be int8, got {pool_k.dtype}")
+    want = d if kv_bits == 8 else d // 2
+    if kv_bits == 4 and d % 2:
+        raise ValueError(f"{what}: packed int4 needs even head_dim {d}")
+    if pool_k.shape[3] != want:
+        raise ValueError(
+            f"{what}: pool last dim {pool_k.shape[3]} != {want} for "
+            f"kv_bits={kv_bits} at head_dim {d}")
+    for name, scale, pool in (("k_scale", k_scale, pool_k),
+                              ("v_scale", v_scale, pool_v)):
+        if scale.shape != pool.shape[:3]:
+            raise ValueError(
+                f"{what}: {name} shape {scale.shape} != pool "
+                f"{pool.shape[:3]}")
+    return want
 
 
 def paged_decode_attention(q: jnp.ndarray, pool_k: jnp.ndarray,
                            pool_v: jnp.ndarray, lengths: jnp.ndarray,
                            block_tables: jnp.ndarray,
                            sm_scale: Optional[float] = None,
-                           interpret: Optional[bool] = None) -> jnp.ndarray:
-    """q [B, H, D]; pool_k/v [num_blocks, block, Hkv, D]; lengths [B]
+                           interpret: Optional[bool] = None,
+                           k_scale: Optional[jnp.ndarray] = None,
+                           v_scale: Optional[jnp.ndarray] = None,
+                           kv_bits: int = 0,
+                           pages_per_program: Optional[int] = None
+                           ) -> jnp.ndarray:
+    """q [B, H, D]; pool_k/v [num_blocks, block, Hkv, De]; lengths [B]
     int32 (valid tokens per slot, 0 = inactive); block_tables [B, pages]
     int32 (pool block ids; unused entries must hold a VALID id — the
-    allocator pads with the reserved null block 0).  Returns [B, H, D];
-    inactive slots come back as zero rows.
+    allocator pads with the reserved null block 0).  With ``kv_bits``
+    8 or 4 the pools are int8 (``De = D`` or ``D//2`` packed) and
+    ``k_scale``/``v_scale`` [num_blocks, block, Hkv] f32 ride along;
+    dequant fuses into the page loop so only compressed bytes cross
+    HBM.  Returns [B, H, D]; inactive slots come back as zero rows.
 
     The caller guarantees ``lengths[i] <= pages * block`` and that every
     table entry below ``ceil(lengths[i]/block)`` points at that slot's
-    own blocks.
+    own blocks.  ``pages_per_program`` overrides the auto-picked group
+    width (the bench sweep's knob).
     """
     b, h, d = q.shape
     nb, block, hkv = pool_k.shape[0], pool_k.shape[1], pool_k.shape[2]
@@ -140,81 +301,122 @@ def paged_decode_attention(q: jnp.ndarray, pool_k: jnp.ndarray,
     if block_tables.ndim != 2 or block_tables.shape[0] != b:
         raise ValueError(
             f"block_tables must be [B={b}, pages], got {block_tables.shape}")
+    d_eff = _check_quant_args(pool_k, pool_v, k_scale, v_scale, kv_bits,
+                              d, "paged_decode_attention")
     groups = h // hkv
     npages = block_tables.shape[1]
+    pp = _pages_per_program(block, npages, pages_per_program)
+    ngroups = -(-npages // pp)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     if interpret is None:
         interpret = _interpret_default()
     lengths = jnp.asarray(lengths, jnp.int32).reshape(b)
     block_tables = jnp.asarray(block_tables, jnp.int32)
+    # [B, H, D] -> [B, Hkv, G, D]: query head j*G+g reads kv head j —
+    # one kv head (and its query group) per middle grid step
+    qg = q.reshape(b, hkv, groups, d)
 
-    def page_index(i, p, len_ref, bt_ref):
-        # pages past the valid prefix revisit the slot's last valid
-        # block: an unchanged block index skips the DMA, so the ragged
-        # tail of a short slot is free.  length 0 degenerates to the
-        # table's first entry (the null block).
-        last = jnp.maximum(
-            (len_ref[i] + block - 1) // block - 1, 0)
-        return (bt_ref[i, jnp.minimum(p, last)], 0, 0, 0)
+    nops = 2 if kv_bits == 0 else 4
+    operands = [qg, pool_k, pool_v]
+    if kv_bits:
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
+    any_specs = [pl.BlockSpec(memory_space=pltpu.ANY)] * (nops)
+    rows = pp * block
+    scratch = [pltpu.VMEM((2, rows, d_eff), pool_k.dtype),
+               pltpu.VMEM((2, rows, d_eff), pool_v.dtype)]
+    if kv_bits:
+        scratch += [pltpu.VMEM((2, rows), jnp.float32),
+                    pltpu.VMEM((2, rows), jnp.float32)]
+    scratch += [pltpu.VMEM((groups, 1), jnp.float32),
+                pltpu.VMEM((groups, 1), jnp.float32),
+                pltpu.VMEM((groups, d), jnp.float32),
+                pltpu.SemaphoreType.DMA((2, nops))]
 
     out = pl.pallas_call(
-        functools.partial(_kernel, sm_scale=sm_scale, block=block,
-                          groups=groups),
+        functools.partial(_decode_kernel, sm_scale=sm_scale, block=block,
+                          pp=pp, kv_bits=kv_bits),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(b, npages),
-            in_specs=[
-                pl.BlockSpec((None, h, d), lambda i, p, *_: (i, 0, 0)),
-                pl.BlockSpec((None, block, hkv, d), page_index),
-                pl.BlockSpec((None, block, hkv, d), page_index),
-            ],
-            out_specs=pl.BlockSpec((None, h, d),
-                                   lambda i, p, *_: (i, 0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((1, h), jnp.float32),
-                pltpu.VMEM((1, h), jnp.float32),
-                pltpu.VMEM((h, d), jnp.float32),
-            ],
+            grid=(b, hkv, ngroups),
+            in_specs=[pl.BlockSpec((None, None, groups, d),
+                                   lambda i, hh, g, *_: (i, hh, 0, 0))]
+            + any_specs,
+            out_specs=pl.BlockSpec((None, None, groups, d),
+                                   lambda i, hh, g, *_: (i, hh, 0, 0)),
+            scratch_shapes=scratch,
         ),
-        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, groups, d), q.dtype),
         compiler_params=compiler_params(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
-    )(lengths, block_tables, q, pool_k, pool_v)
-    return out
+    )(lengths, block_tables, *operands)
+    return out.reshape(b, h, d)
 
 
-def _prefill_kernel(meta_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
-                    m_scr, l_scr, acc_scr, *, sm_scale, block):
-    """Causal multi-token chunk attention over one slot's pages.
+def _prefill_kernel(meta_ref, bt_ref, q_ref, *refs, sm_scale, block, pp,
+                    kv_bits):
+    """Causal multi-token chunk attention over one slot's page groups.
 
-    Grid ``(kv_head, page)``.  q_ref [G, C, D] (this kv head's query
-    group, rotary already applied); k_ref/v_ref [block, D] (this kv
-    head's slice of the page the index_map selected via the block
-    table); o_ref [G, C, D]; scratch m/l [G, C], acc [G, C, D].
-    ``meta_ref`` carries [base, total_len]: queries sit at absolute
-    rows base..base+C-1, rows below ``base`` are prior context (fully
+    Grid ``(kv_head, page_group)``.  q_ref [G, C, D] (this kv head's
+    query group, rotary already applied); VMEM buffers as in the decode
+    kernel; scratch m/l [G, C], acc [G, C, D] f32.  ``meta_ref``
+    carries [base, total_len]: queries sit at absolute rows
+    base..base+C-1, rows below ``base`` are prior context (fully
     visible), causality applies inside the chunk, and nothing at or
     past ``total_len`` is attended."""
-    p = pl.program_id(1)
-    npages = pl.num_programs(1)
-    base, total = meta_ref[0], meta_ref[1]
+    nops = 2 if kv_bits == 0 else 4
+    hbm = refs[:nops]
+    o_ref = refs[nops]
+    bufs = refs[nops + 1:nops + 1 + nops]
+    m_scr, l_scr, acc_scr, sem = refs[nops + 1 + nops:]
 
-    @pl.when(p == 0)
+    hh, g = pl.program_id(0), pl.program_id(1)
+    nh, ng = pl.num_programs(0), pl.num_programs(1)
+    npages = bt_ref.shape[0]
+    rows = pp * block
+    base, total = meta_ref[0], meta_ref[1]
+    step = hh * ng + g
+    buf = jax.lax.rem(step, 2)
+
+    def fetch(head, group, into_buf, start):
+        srcs = [r.at[:, :, head] for r in hbm]
+        fn = _start_group if start else _wait_group
+        fn(srcs, bufs, sem, bt_ref, None, total, npages, block, pp,
+           group, into_buf)
+
+    @pl.when(step == 0)
+    def _cold_start():
+        fetch(hh, g, buf, start=True)
+
+    g1 = g + 1
+    h1 = hh + g1 // ng
+    g1 = jax.lax.rem(g1, ng)
+
+    @pl.when(h1 < nh)
+    def _prefetch_next():
+        fetch(jax.lax.rem(h1, nh), g1, jax.lax.rem(step + 1, 2),
+              start=True)
+
+    fetch(hh, g, buf, start=False)
+
+    @pl.when(g == 0)
     def _init():
         m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    @pl.when(p * block < total)
+    @pl.when(g * rows < total)
     def _body():
         q = q_ref[...].astype(jnp.float32)            # [G, C, D]
-        k = k_ref[...].astype(jnp.float32)            # [block, D]
+        kf = _dequant_rows(bufs[0][buf],
+                           bufs[2][buf] if kv_bits else None,
+                           kv_bits)                   # [T, D] f32
         scores = jax.lax.dot_general(
-            q, k, (((2,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale   # [G, C, block]
-        pos = p * block + jax.lax.broadcasted_iota(
+            q, kf, (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # [G, C, T]
+        pos = g * rows + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 2)
         qpos = base + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 1)
@@ -223,26 +425,27 @@ def _prefill_kernel(meta_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
         m_prev = m_scr[...]                           # [G, C]
         m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1))
         alpha = jnp.exp(m_prev - m_new)               # [G, C]
-        probs = jnp.exp(scores - m_new[..., None])    # [G, C, block]
+        probs = jnp.exp(scores - m_new[..., None])    # [G, C, T]
         l_scr[...] = alpha * l_scr[...] + jnp.sum(probs, axis=-1)
-        v = v_ref[...].astype(jnp.float32)            # [block, D]
-        # rows at/past total carry recycled-pool garbage that may be
-        # non-finite (quarantine discards): zero them — masked probs are
-        # ~0 but 0 * NaN would still poison the accumulator
-        v = jnp.where((pos[0, 0, :] < total)[:, None], v, 0.0)
+        vf = _dequant_rows(bufs[1][buf],
+                           bufs[3][buf] if kv_bits else None,
+                           kv_bits)                   # [T, D] f32
+        # rows at/past total carry recycled-pool (or never-fetched
+        # buffer) garbage that may be non-finite: zero them — masked
+        # probs are ~0 but 0 * NaN would still poison the accumulator
+        rowpos = g * rows + jax.lax.broadcasted_iota(
+            jnp.int32, (kf.shape[0], 1), 0)
+        vf = jnp.where(rowpos < total, vf, 0.0)
         pv = jax.lax.dot_general(
-            probs, v, (((2,), (0,)), ((), ())),
+            probs, vf, (((2,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # [G, C, D]
-        # alpha indexes the leading (sublane) dims and broadcasts over
-        # the lane dim — no relayout (unlike the decode kernel's [1, H]
-        # lane-vector, which needs the diag-matmul trick)
         acc_scr[...] = alpha[..., None] * acc_scr[...] + pv
         m_scr[...] = m_new
 
-    @pl.when(p == npages - 1)
+    @pl.when(g == ng - 1)
     def _out():
         # a zero-length chunk (idle prefill lane in the mixed program)
-        # never ran a page: l stays 0 and the clamp yields zero rows
+        # never ran a group: l stays 0 and the clamp yields zero rows
         inv = 1.0 / jnp.maximum(l_scr[...], 1e-30)    # [G, C]
         o_ref[...] = (inv[..., None] * acc_scr[...]).astype(o_ref.dtype)
 
@@ -252,21 +455,27 @@ def paged_prefill_attention(q: jnp.ndarray, pool_k: jnp.ndarray,
                             chunk_len: jnp.ndarray,
                             block_table: jnp.ndarray,
                             sm_scale: Optional[float] = None,
-                            interpret: Optional[bool] = None
+                            interpret: Optional[bool] = None,
+                            k_scale: Optional[jnp.ndarray] = None,
+                            v_scale: Optional[jnp.ndarray] = None,
+                            kv_bits: int = 0,
+                            pages_per_program: Optional[int] = None
                             ) -> jnp.ndarray:
     """Causal chunked-prefill attention for ONE slot through its block
     table (the Sarathi-Serve mixed-batch building block).
 
     q [C, H, D] — a chunk of C query tokens at absolute rows
     ``base .. base+C-1`` (rotary already applied); pool_k/v
-    [num_blocks, block, Hkv, D]; ``base`` int32 scalar (rows of prior
-    context already in the pool); ``chunk_len`` int32 scalar (valid
-    queries; rows past it are padding — finite garbage out, callers
-    ignore them); block_table [pages] int32 (the slot's pages, padded
-    with the reserved null block 0).  The chunk's OWN k/v must already
-    be scattered into the pool at rows base.. (the model does this
-    immediately before the call), so the kernel reads every key — prior
-    and in-chunk — through one uniform page walk.  Returns [C, H, D].
+    [num_blocks, block, Hkv, De] (+ ``k_scale``/``v_scale`` when
+    ``kv_bits`` is 8 or 4 — see :func:`paged_decode_attention`);
+    ``base`` int32 scalar (rows of prior context already in the pool);
+    ``chunk_len`` int32 scalar (valid queries; rows past it are padding
+    — finite garbage out, callers ignore them); block_table [pages]
+    int32 (the slot's pages, padded with the reserved null block 0).
+    The chunk's OWN k/v must already be scattered into the pool at rows
+    base.. (the model does this immediately before the call), so the
+    kernel reads every key — prior and in-chunk — through one uniform
+    double-buffered page walk.  Returns [C, H, D].
     """
     c, h, d = q.shape
     nb, block, hkv = pool_k.shape[0], pool_k.shape[1], pool_k.shape[2]
@@ -277,8 +486,12 @@ def paged_prefill_attention(q: jnp.ndarray, pool_k: jnp.ndarray,
     if block_table.ndim != 1:
         raise ValueError(
             f"block_table must be [pages], got {block_table.shape}")
+    d_eff = _check_quant_args(pool_k, pool_v, k_scale, v_scale, kv_bits,
+                              d, "paged_prefill_attention")
     groups = h // hkv
     npages = block_table.shape[0]
+    pp = _pages_per_program(block, npages, pages_per_program)
+    ngroups = -(-npages // pp)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     if interpret is None:
@@ -290,47 +503,64 @@ def paged_prefill_attention(q: jnp.ndarray, pool_k: jnp.ndarray,
     # outer grid step keeps the f32 accumulator at G*C*D, not H*C*D
     qg = q.reshape(c, hkv, groups, d).transpose(1, 2, 0, 3)
 
-    def page_index(hh, p, meta_ref, bt_ref):
-        # pages past the valid total revisit the last valid block (an
-        # unchanged index skips the DMA); total 0 degenerates to the
-        # table's first entry (the null block)
-        last = jnp.maximum((meta_ref[1] + block - 1) // block - 1, 0)
-        return (bt_ref[jnp.minimum(p, last)], 0, hh, 0)
-
-    out = pl.pallas_call(
-        functools.partial(_prefill_kernel, sm_scale=sm_scale, block=block),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=(hkv, npages),
-            in_specs=[
-                pl.BlockSpec((None, groups, c, d),
-                             lambda hh, p, *_: (hh, 0, 0, 0)),
-                pl.BlockSpec((None, block, None, d), page_index),
-                pl.BlockSpec((None, block, None, d), page_index),
-            ],
-            out_specs=pl.BlockSpec((None, groups, c, d),
-                                   lambda hh, p, *_: (hh, 0, 0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((groups, c), jnp.float32),
+    nops = 2 if kv_bits == 0 else 4
+    operands = [qg, pool_k, pool_v]
+    if kv_bits:
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
+    rows = pp * block
+    scratch = [pltpu.VMEM((2, rows, d_eff), pool_k.dtype),
+               pltpu.VMEM((2, rows, d_eff), pool_v.dtype)]
+    if kv_bits:
+        scratch += [pltpu.VMEM((2, rows), jnp.float32),
+                    pltpu.VMEM((2, rows), jnp.float32)]
+    scratch += [pltpu.VMEM((groups, c), jnp.float32),
                 pltpu.VMEM((groups, c), jnp.float32),
                 pltpu.VMEM((groups, c, d), jnp.float32),
-            ],
+                pltpu.SemaphoreType.DMA((2, nops))]
+
+    out = pl.pallas_call(
+        functools.partial(_prefill_kernel, sm_scale=sm_scale, block=block,
+                          pp=pp, kv_bits=kv_bits),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(hkv, ngroups),
+            in_specs=[pl.BlockSpec((None, groups, c, d),
+                                   lambda hh, g, *_: (hh, 0, 0, 0))]
+            + [pl.BlockSpec(memory_space=pltpu.ANY)] * nops,
+            out_specs=pl.BlockSpec((None, groups, c, d),
+                                   lambda hh, g, *_: (hh, 0, 0, 0)),
+            scratch_shapes=scratch,
         ),
         out_shape=jax.ShapeDtypeStruct((hkv, groups, c, d), q.dtype),
         compiler_params=compiler_params(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
-    )(meta, block_table, qg, pool_k, pool_v)
+    )(meta, block_table, *operands)
     return out.transpose(2, 0, 1, 3).reshape(c, h, d)
 
 
+def _reference_pools(pool_k, pool_v, k_scale, v_scale, kv_bits):
+    """Dequantize (or pass through) the pools for the jnp references —
+    ``kv_dequantize`` is the exact math the kernels fuse in."""
+    if kv_bits == 0:
+        return pool_k, pool_v
+    from ..quantizer.quantizer import kv_dequantize
+    return (kv_dequantize(pool_k, k_scale, kv_bits),
+            kv_dequantize(pool_v, v_scale, kv_bits))
+
+
 def paged_prefill_reference(q, pool_k, pool_v, base, chunk_len,
-                            block_table):
+                            block_table, k_scale=None, v_scale=None,
+                            kv_bits=0):
     """Readable jnp reference for the chunked-prefill kernel (tests pin
-    against this): gather the table's pages into a contiguous cache and
-    run causally-masked dense attention for the chunk's rows.  Padding
-    queries (index >= chunk_len) are returned as zeros."""
+    against this): dequantize if needed, gather the table's pages into
+    a contiguous cache and run causally-masked dense attention for the
+    chunk's rows.  Padding queries (index >= chunk_len) are returned as
+    zeros."""
     c, h, d = q.shape
+    pool_k, pool_v = _reference_pools(pool_k, pool_v, k_scale, v_scale,
+                                      kv_bits)
     block = pool_k.shape[1]
     hkv = pool_k.shape[2]
     npages = block_table.shape[0]
@@ -353,11 +583,15 @@ def paged_prefill_reference(q, pool_k, pool_v, base, chunk_len,
     return jnp.where(valid, out, 0.0).astype(q.dtype)
 
 
-def paged_attention_reference(q, pool_k, pool_v, lengths, block_tables):
+def paged_attention_reference(q, pool_k, pool_v, lengths, block_tables,
+                              k_scale=None, v_scale=None, kv_bits=0):
     """Readable jnp reference (tests pin the kernel against this): per
-    slot, gather the table's pages into a contiguous cache and run
-    masked dense attention.  O(B·pages·block) gather — test-scale only."""
+    slot, dequantize if needed, gather the table's pages into a
+    contiguous cache and run masked dense attention.  O(B·pages·block)
+    gather — test-scale only."""
     b, h, d = q.shape
+    pool_k, pool_v = _reference_pools(pool_k, pool_v, k_scale, v_scale,
+                                      kv_bits)
     block = pool_k.shape[1]
     hkv = pool_k.shape[2]
     npages = block_tables.shape[1]

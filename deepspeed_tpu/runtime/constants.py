@@ -142,6 +142,13 @@ SERVING_NO_PROGRESS_STEPS_DEFAULT = 64
 # WAITING and RUNNING requests; 0 = no deadline. submit(deadline_s=...)
 # overrides per request.
 SERVING_DEFAULT_DEADLINE_S_DEFAULT = 0.0
+# quantized KV cache: store the paged pool at this many bits per value
+# (0 = the engine dtype, byte-identical to the pre-quantization path;
+# 8 = int8; 4 = packed int4, two values per byte) with per-row per-head
+# f32 scales alongside — decode moves ~2x/~3.8x fewer HBM bytes and the
+# same pool HBM budget holds that many more tokens (docs/serving.md
+# "Quantized KV cache")
+SERVING_KV_CACHE_BITS_DEFAULT = 0
 
 # The reference's inference-route keys (ROUTE_TRAIN/EVAL/PREDICT/ENCODE)
 # and a top-level MOE block key were carried here for five PRs without a

@@ -51,6 +51,51 @@ def decode_diag():
           flush=True)
 
 
+def paged_decode_diag():
+    """True paged-kernel times with in-program chaining: the ISSUE 8
+    roofline levers (pages-per-program, kv bits) isolated from dispatch
+    latency — one compiled fori_loop per configuration."""
+    from deepspeed_tpu.inference.serving.block_allocator import (
+        kv_block_bytes)
+    from deepspeed_tpu.ops.quantizer import kv_quantize
+    from deepspeed_tpu.ops.transformer.paged_decode_attention import (
+        paged_decode_attention)
+    slots, h, d, cache, block = 8, 16, 128, 16384, 256
+    rs = np.random.RandomState(0)
+    pages = cache // block
+    nb = slots * pages + 1
+    lens = jnp.full((slots,), cache, jnp.int32)
+    bt = jnp.asarray(
+        np.arange(1, nb).reshape(slots, pages), jnp.int32)
+    q = jnp.asarray(rs.randn(slots, h, d), jnp.bfloat16)
+    pk16 = jnp.asarray(rs.randn(nb, block, h, d), jnp.bfloat16)
+    pv16 = jnp.asarray(rs.randn(nb, block, h, d), jnp.bfloat16)
+    for bits in (0, 8, 4):
+        if bits:
+            pk, ks = kv_quantize(pk16, bits)
+            pv, vs = kv_quantize(pv16, bits)
+        else:
+            pk, pv, ks, vs = pk16, pv16, None, None
+        # per-row values+scales bytes via the pinned sizing rule
+        gb = float(slots * cache) * kv_block_bytes(1, h, d, bits) / 2**30
+        for pp in (1, 4, 8):
+
+            @jax.jit
+            def chain(q, pk, pv, ks, vs, pp=pp, bits=bits):
+                def body(i, qq):
+                    return paged_decode_attention(
+                        qq, pk, pv, lens, bt, k_scale=ks, v_scale=vs,
+                        kv_bits=bits, pages_per_program=pp)
+                return jax.lax.fori_loop(0, 16, body, q)
+
+            ms = timed(chain, q, pk, pv, ks, vs, inner=16)
+            print(json.dumps({
+                "kernel": "paged_decode_16k", "kv_bits": bits,
+                "pages_per_program": pp, "ms": round(ms, 3),
+                "achieved_gbps": round(gb / (ms / 1e3), 1)}),
+                flush=True)
+
+
 def attn_diag():
     from deepspeed_tpu.ops.sparse_attention import (
         LocalSlidingWindowSparsityConfig, blocksparse_attention_bthd)
@@ -99,4 +144,5 @@ def attn_diag():
 
 if __name__ == "__main__":
     decode_diag()
+    paged_decode_diag()
     attn_diag()

@@ -95,10 +95,13 @@ if [[ -z "$FILTER" || "observability" == *"$FILTER"* ]]; then
   fi
 fi
 
-# Inference/serving sweep: paged decode-attention kernel parity, block
-# allocator leak properties, and the continuous-batching integration
-# test (pytest.ini `inference` marker; docs/serving.md) — all forced-CPU
-# (the kernel runs in interpret mode off-TPU).
+# Inference/serving sweep: paged decode-attention kernel parity —
+# including the ISSUE 8 multi-page x GQA x ragged x kv-bits {0,8,4}
+# quantized-pool sweep — block allocator leak properties (fuzzed at
+# bf16- AND int8-budget pool sizes), KV capacity accounting, and the
+# continuous-batching integration tests incl. the 8-bit exact-stream
+# acceptance (pytest.ini `inference` marker; docs/serving.md) — all
+# forced-CPU (the kernels run in interpret mode off-TPU).
 if [[ -z "$FILTER" || "inference" == *"$FILTER"* || "serving" == *"$FILTER"* ]]; then
   echo "=== inference/serving marker sweep (pytest -m inference)"
   if JAX_PLATFORMS=cpu python -m pytest tests/unit/test_serving.py \
@@ -110,9 +113,11 @@ if [[ -z "$FILTER" || "inference" == *"$FILTER"* || "serving" == *"$FILTER"* ]];
 fi
 
 # Serving-chaos sweep: the `chaos`-marked suite (randomized cancels,
-# deadlines, quarantine, preemption) replayed across a DSTPU_FAULTS
-# matrix over the serving injection sites — every schedule must drain
-# leak-free with OK streams exact (docs/serving.md "Failure handling").
+# deadlines, quarantine, preemption; the staged scenario additionally
+# parametrized over kv_cache_bits 0 and 8) replayed across a
+# DSTPU_FAULTS matrix over the serving injection sites — every
+# schedule must drain leak-free with OK streams exact (docs/serving.md
+# "Failure handling").
 if [[ -z "$FILTER" || "chaos" == *"$FILTER"* || "serving" == *"$FILTER"* ]]; then
   CHAOS_MATRIX=(
     ""

@@ -49,6 +49,68 @@ class TestQuantizer:
         g = jax.grad(lambda x: jnp.sum(fake_quantize(x, 8, 1) * 2.0))(x)
         np.testing.assert_allclose(np.asarray(g), 2.0)  # STE passes grads
 
+    @pytest.mark.parametrize("n", [1, 2, 7, 16, 63, 128])
+    def test_int4_pack_roundtrip_shape_preserving(self, n):
+        """pack_int4/unpack_int4 round-trip every value exactly,
+        including ODD trailing sizes (the pad nibble is dropped on the
+        way back)."""
+        from deepspeed_tpu.ops.quantizer import pack_int4, unpack_int4
+        rs = np.random.RandomState(n)
+        q = rs.randint(-8, 8, (3, n)).astype(np.int8)
+        p = pack_int4(jnp.asarray(q))
+        assert p.dtype == jnp.int8 and p.shape == (3, (n + 1) // 2)
+        u = unpack_int4(p, n)
+        assert u.shape == q.shape
+        np.testing.assert_array_equal(np.asarray(u), q)
+
+    @pytest.mark.parametrize("group", [17, 64, 256])
+    def test_int4_packed_quantize_matches_unpacked(self, group):
+        """The packed int4 encode is bit-equivalent to the unpacked one
+        after dequantize — property-tested against the f32 reference
+        across group sizes including odd trailing groups."""
+        from deepspeed_tpu.ops.quantizer import dequantize, quantize
+        rs = np.random.RandomState(group)
+        x = jnp.asarray(rs.randn(4, group).astype(np.float32))
+        qp, sp, _ = quantize(x, num_bits=4, num_groups=4, pack=True)
+        qu, su, _ = quantize(x, num_bits=4, num_groups=4)
+        assert qp.shape[-1] == (group + 1) // 2
+        yp = dequantize(qp, sp, None, x.shape, packed=True)
+        yu = dequantize(qu, su, None, x.shape)
+        np.testing.assert_array_equal(np.asarray(yp), np.asarray(yu))
+        # int4 error bound vs the f32 reference: within one quant step
+        assert float(jnp.max(jnp.abs(yp - x))) <= float(jnp.max(sp)) * 0.5 \
+            + 1e-6
+
+    def test_pack_requires_symmetric_int4(self):
+        from deepspeed_tpu.ops.quantizer import quantize
+        x = jnp.ones((2, 8))
+        with pytest.raises(ValueError, match="int4"):
+            quantize(x, num_bits=8, num_groups=2, pack=True)
+
+    @pytest.mark.parametrize("bits,tol", [(8, 1 / 127), (4, 1 / 7)])
+    def test_kv_quantize_roundtrip_bound(self, bits, tol):
+        """The KV-cache encode (per-row per-head scales, feature-split
+        int4 packing) round-trips within the symmetric quantization
+        error bound: half a step of each row's own scale."""
+        from deepspeed_tpu.ops.quantizer import kv_dequantize, kv_quantize
+        rs = np.random.RandomState(bits)
+        x = rs.randn(6, 3, 64).astype(np.float32) * \
+            rs.uniform(0.1, 10, (6, 3, 1))        # spread of row scales
+        q, scale = kv_quantize(jnp.asarray(x), bits)
+        assert q.dtype == jnp.int8
+        assert q.shape[-1] == (64 if bits == 8 else 32)
+        assert scale.shape == (6, 3)
+        y = np.asarray(kv_dequantize(q, scale, bits))
+        bound = np.abs(x).max(axis=-1, keepdims=True) * tol * 0.5 + 1e-6
+        assert (np.abs(y - x) <= bound).all()
+
+    def test_kv_quantize_rejects_bad_bits_and_odd_dim(self):
+        from deepspeed_tpu.ops.quantizer import kv_quantize
+        with pytest.raises(ValueError, match="4 or 8"):
+            kv_quantize(jnp.ones((2, 4)), 5)
+        with pytest.raises(ValueError, match="even head_dim"):
+            kv_quantize(jnp.ones((2, 7)), 4)
+
 
 class TestCompression:
     def test_bits_schedule(self):

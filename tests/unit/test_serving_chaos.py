@@ -37,7 +37,8 @@ def env_injector():
     install_fault_injector(FaultInjector())
 
 
-def chaos_engine(num_kv_blocks=16, slots=3, max_queue_depth=16):
+def chaos_engine(num_kv_blocks=16, slots=3, max_queue_depth=16,
+                 kv_cache_bits=0):
     cfg = gpt2_config("125m", num_layers=2, d_model=32, num_heads=4,
                       vocab_size=64, max_seq_len=64, dtype=jnp.float32)
     eng = ds.init_inference(TransformerLM(cfg), config={
@@ -48,8 +49,20 @@ def chaos_engine(num_kv_blocks=16, slots=3, max_queue_depth=16):
                     "max_batch_slots": slots,
                     "prefill_chunk_tokens": 8,
                     "max_preemptions": 4,
-                    "max_queue_depth": max_queue_depth}})
+                    "max_queue_depth": max_queue_depth,
+                    "kv_cache_bits": kv_cache_bits}})
     return eng, eng.serving_engine()
+
+
+def poison_slot_kv(srv, req):
+    """NaN-poison the request's first KV block — through the SCALE
+    plane when the pool is quantized (an int8 pool cannot hold NaN;
+    NaN scales are exactly what dequant spreads over the block)."""
+    blocks = srv.allocator.block_table(req.req_id)
+    if srv.kv_bits:
+        srv._pool_ks = srv._pool_ks.at[:, blocks[0]].set(jnp.nan)
+    else:
+        srv._pool_k = srv._pool_k.at[:, blocks[0]].set(jnp.nan)
 
 
 def _generate(eng, prompt, n):
@@ -81,11 +94,18 @@ def assert_drained_clean(srv, reqs, finished):
             assert r in finished
 
 
-def test_chaos_staged_faults_cancels_deadlines(env_injector):
+@pytest.mark.parametrize("kv_cache_bits", [0, 8])
+def test_chaos_staged_faults_cancels_deadlines(env_injector,
+                                               kv_cache_bits):
     """The scripted scenario: staggered waves under KV pressure, one
     deadline expiry, one mid-flight cancel, one poisoned (NaN) slot —
-    plus whatever DSTPU_FAULTS adds."""
-    eng, srv = chaos_engine()
+    plus whatever DSTPU_FAULTS adds.  Runs at bf16 AND int8 KV: the
+    quantized pool must satisfy the identical invariants — a
+    quarantine discard drops the block (scales ride the block id, so
+    they are recycled with it and overwritten at the next scatter),
+    prefix-cache hits reuse scales, and OK streams at 8-bit stay
+    token-exact against the bf16-cache generate() on the toy model."""
+    eng, srv = chaos_engine(kv_cache_bits=kv_cache_bits)
     rs = np.random.RandomState(1009)
     new = 8
     prompts = [rs.randint(0, 64, (n,)).tolist()
@@ -113,8 +133,7 @@ def test_chaos_staged_faults_cancels_deadlines(env_injector):
                    if r.state is RequestState.RUNNING and r.status is None
                    and not r.prefilling and len(r.output) < new - 2), None)
     if poison is not None:
-        blocks = srv.allocator.block_table(poison.req_id)
-        srv._pool_k = srv._pool_k.at[:, blocks[0]].set(jnp.nan)
+        poison_slot_kv(srv, poison)
     finished = srv.run()
 
     assert_drained_clean(srv, reqs, finished)
