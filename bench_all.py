@@ -8,9 +8,20 @@ from __future__ import annotations
 
 import functools
 import json
+import os
 import time
 
 import numpy as np
+
+# tp_decode_bench needs the virtual 8-device CPU mesh (same forcing as
+# tests/conftest.py); the flag only affects the HOST platform backend,
+# so it is a no-op on real TPU runs.  Must land before the first jax
+# backend use — every bench imports jax lazily, so module top is safe.
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
 
 
 def train_bench(size: str, micro: int, seq: int, zero_stage: int,
@@ -132,6 +143,66 @@ def serving_decode_bench(size: str = "125m", slots: int = 8,
         "quarantine_rate": round(lc["quarantined"] / n_req, 3),
         "cancelled": lc["cancelled"], "failed": lc["failed"],
         "decode_builds": srv.decode_builds}), flush=True)
+
+
+def tp_decode_bench(slots: int = 8, prompt: int = 24, new: int = 32):
+    """Tensor-parallel paged serving over the (data, model) mesh
+    (docs/serving.md "Tensor-parallel serving"), swept over model ∈
+    {1, 2, 4} with data = 8 / model on the forced 8-device CPU mesh —
+    the MULTICHIP_* trajectory's serving row.  Reports per mesh shape:
+    end-to-end serving tokens/s, the measured PER-CHIP KV pool bytes
+    (must fall as 1/model), and the per-token collective volume the
+    model axis costs (bytes psummed per layer x layers; zero at
+    model=1).  CPU wall-times only order WITHIN this sweep — the
+    numbers that transfer to TPU are the bytes columns."""
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+
+    if len(jax.devices()) < 8:
+        print(json.dumps({"metric": "serving_tp_tokens_per_sec",
+                          "skipped": f"{len(jax.devices())} devices"}),
+              flush=True)
+        return
+    # CPU-sized toy (the tier-1 test model): the sweep is about mesh
+    # SHAPES, not model scale
+    cfg = gpt2_config("125m", num_layers=4, d_model=64, num_heads=4,
+                      max_seq_len=prompt + new + 8, vocab_size=256,
+                      dtype=jnp.float32)
+    params = TransformerLM(cfg).init(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, cfg.vocab_size, (prompt,)).tolist()
+               for _ in range(2 * slots)]
+    for model_size in (1, 2, 4):
+        eng = ds.init_inference(TransformerLM(cfg), params=params, config={
+            "dtype": "float32", "max_out_tokens": prompt + new + 8,
+            "temperature": 0.0, "replace_with_kernel_inject": False,
+            "serving": {"enabled": True, "kv_block_size": 8,
+                        "num_kv_blocks": slots * ((prompt + new) // 8 + 1)
+                        + 8,
+                        "max_batch_slots": slots,
+                        "prefill_chunk_tokens": 32,
+                        "mesh": {"data": 8 // model_size,
+                                 "model": model_size}}})
+        srv = eng.serving_engine()
+        srv.submit(prompts[0], max_new_tokens=2)    # compile off-clock
+        srv.run(max_steps=50)
+        t0 = time.perf_counter()
+        reqs = [srv.submit(p, max_new_tokens=new) for p in prompts]
+        srv.run(max_steps=100 * len(prompts) * new)
+        dt = time.perf_counter() - t0
+        toks = sum(len(r.output) for r in reqs)
+        psum_b = srv.tp_psum_bytes_per_token_layer
+        print(json.dumps({
+            "metric": "serving_tp_tokens_per_sec",
+            "value": round(toks / dt, 1), "unit": "tokens/s",
+            "mesh": {"data": 8 // model_size, "model": model_size},
+            "slots": slots,
+            "kv_pool_bytes_per_chip": srv.kv_pool_bytes,
+            "psum_bytes_per_token_layer": psum_b,
+            "psum_bytes_per_token": psum_b * cfg.num_layers,
+            "decode_builds": srv.decode_builds}), flush=True)
 
 
 def prefix_cache_bench(size: str = "125m", slots: int = 8,
@@ -749,6 +820,9 @@ def main():
     else:
         train_bench("125m", 2, 128, 0, iters=3, num_layers=4, d_model=256,
                     num_heads=8)
+        # the (data, model) serving sweep runs on the forced 8-device
+        # CPU mesh — mesh-shape coverage, not absolute throughput
+        tp_decode_bench()
 
 
 if __name__ == "__main__":

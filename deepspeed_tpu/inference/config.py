@@ -16,6 +16,33 @@ from ..runtime import constants as C
 from ..runtime.config_utils import ConfigModel
 
 
+class ServingMeshConfig(ConfigModel):
+    """``serving.mesh`` block — the (data, model) submesh the mixed
+    decode+prefill program shards over (docs/serving.md
+    "Tensor-parallel serving").
+
+    ``model`` splits attention heads, the paged KV pool (values AND the
+    int8/int4 scale planes) and the MLP column/row-wise, so each chip
+    holds ``kv_heads / model`` of every block — per-chip pool HBM drops
+    by the same factor.  ``data`` partitions the decode slots, so
+    ``data * model`` chips serve ``data`` x the concurrent slots.  Block
+    ids, the allocator, prefix-cache digests and the scheduler stay
+    replicated host-side and unchanged.  ``1 x 1`` (the default) keeps
+    the single-device program byte-identical to the pre-TP path."""
+    data: int = C.SERVING_MESH_DATA_DEFAULT
+    model: int = C.SERVING_MESH_MODEL_DEFAULT
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.data < 1:
+            raise ValueError(
+                f"serving.mesh.data must be >= 1, got {self.data}")
+        if self.model < 1:
+            raise ValueError(
+                f"serving.mesh.model must be >= 1, got {self.model}")
+        return self
+
+
 class ServingConfig(ConfigModel):
     """``serving`` block — continuous-batching inference
     (`inference/serving/`, docs/serving.md).
@@ -65,6 +92,11 @@ class ServingConfig(ConfigModel):
     # RUNNING requests (terminal status TIMED_OUT); 0 = none;
     # submit(deadline_s=...) overrides per request
     default_deadline_s: float = C.SERVING_DEFAULT_DEADLINE_S_DEFAULT
+    # (data, model) serving submesh — see ServingMeshConfig; shape
+    # constraints the model config imposes (model | kv_heads,
+    # data | max_batch_slots) are checked at ServingEngine build, where
+    # the model is known
+    mesh: ServingMeshConfig = Field(default_factory=ServingMeshConfig)
 
     @model_validator(mode="after")
     def _validate(self):
@@ -105,6 +137,11 @@ class ServingConfig(ConfigModel):
             raise ValueError(
                 f"serving.default_deadline_s must be >= 0 (0 = none), "
                 f"got {self.default_deadline_s}")
+        if self.max_batch_slots % self.mesh.data:
+            raise ValueError(
+                f"serving.mesh.data ({self.mesh.data}) must divide "
+                f"serving.max_batch_slots ({self.max_batch_slots}) — "
+                f"decode slots partition evenly over the data axis")
         return self
 
 

@@ -171,8 +171,10 @@ class InferenceEngine:
                           if p and str(p[0].key) == "blocks"
                           else tuple(l.shape)), tmpl)
 
-        tp_live = (self.config.tensor_parallel.enabled
-                   and self.config.tensor_parallel.tp_size > 1)
+        tp_live = ((self.config.tensor_parallel.enabled
+                    and self.config.tensor_parallel.tp_size > 1)
+                   or (self.config.serving.enabled
+                       and self.config.serving.mesh.model > 1))
         # grouped scales reshape the flat weight to [G, -1]: groups cross
         # TP shard boundaries, so TP serving uses per-output-CHANNEL
         # scales instead (reference GroupQuantizer slices groups per TP

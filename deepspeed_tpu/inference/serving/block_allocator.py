@@ -53,15 +53,25 @@ _SCALE_BYTES = 4
 
 
 def kv_block_bytes(block_size: int, kv_heads: int, head_dim: int,
-                   kv_bits: int = 0, cache_itemsize: int = 2) -> int:
-    """Device HBM bytes one pool block costs across k AND v, including
-    the per-row per-head f32 scales a quantized pool stores alongside
-    (``serving.kv_cache_bits``).  ``cache_itemsize`` is the
-    unquantized pool's dtype width (2 = bf16).  Pure ints — the
-    capacity-planning mirror of ``models/transformer.py
-    init_paged_cache``, pinned against it by test."""
+                   kv_bits: int = 0, cache_itemsize: int = 2,
+                   model_shards: int = 1) -> int:
+    """PER-CHIP device HBM bytes one pool block costs across k AND v,
+    including the per-row per-head f32 scales a quantized pool stores
+    alongside (``serving.kv_cache_bits``).  ``cache_itemsize`` is the
+    unquantized pool's dtype width (2 = bf16).  ``model_shards`` is the
+    serving mesh's model-axis size: each chip then holds
+    ``kv_heads / model_shards`` of every block (scale planes included),
+    so the per-block cost divides by it — the data axis replicates the
+    pool and changes nothing here.  Pure ints — the capacity-planning
+    mirror of ``models/transformer.py init_paged_cache``, pinned
+    against it by test."""
     if kv_bits not in (0, 4, 8):
         raise ValueError(f"kv_bits must be 0, 4 or 8, got {kv_bits}")
+    if model_shards < 1 or kv_heads % model_shards:
+        raise ValueError(
+            f"model_shards ({model_shards}) must be >= 1 and divide "
+            f"kv_heads ({kv_heads})")
+    kv_heads //= model_shards
     if kv_bits == 0:
         per_row = kv_heads * head_dim * cache_itemsize
     else:
@@ -72,14 +82,18 @@ def kv_block_bytes(block_size: int, kv_heads: int, head_dim: int,
 
 def blocks_for_budget(budget_bytes: int, block_size: int, kv_heads: int,
                       head_dim: int, kv_bits: int = 0,
-                      cache_itemsize: int = 2) -> int:
-    """Pool blocks (INCLUDING the reserved null block 0) a device HBM
-    budget admits at the given KV width — the ``kv_cache_bits`` sizing
-    rule: the same budget holds ~2x the blocks at 8-bit and ~3.8x at
-    packed 4-bit, which is the concurrency the scheduler can actually
-    admit."""
+                      cache_itemsize: int = 2,
+                      model_shards: int = 1) -> int:
+    """Pool blocks (INCLUDING the reserved null block 0) a PER-CHIP
+    device HBM budget admits at the given KV width — the
+    ``kv_cache_bits`` sizing rule: the same budget holds ~2x the blocks
+    at 8-bit and ~3.8x at packed 4-bit, which is the concurrency the
+    scheduler can actually admit.  With ``model_shards`` > 1 the same
+    per-chip budget holds ``model_shards`` x the blocks, because each
+    chip carries only its ``kv_heads / model_shards`` slice."""
     return budget_bytes // kv_block_bytes(block_size, kv_heads, head_dim,
-                                          kv_bits, cache_itemsize)
+                                          kv_bits, cache_itemsize,
+                                          model_shards)
 
 
 class BlockPoolError(ServingError):

@@ -48,8 +48,11 @@ from typing import Any, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...observability import get_registry, trace_span
+from ...parallel import topology as topo
+from ...parallel.shard_map_compat import shard_map
 from ...runtime.resilience.errors import (FatalIOError, ServingError,
                                           TransientIOError)
 from ...runtime.resilience.fault_injection import get_fault_injector
@@ -57,6 +60,28 @@ from ...utils.logging import logger
 from .block_allocator import PagedBlockAllocator
 from .scheduler import (ContinuousBatchingScheduler, Request,
                         RequestStatus)
+
+
+def _tp_qkv_perm(nh: int, nkv: int, hd: int, mp: int) -> np.ndarray:
+    """Column permutation carrying the fused global qkv layout
+    ``[q(nh*hd) | k(nkv*hd) | v(nkv*hd)]`` into ``mp`` contiguous
+    per-shard fused layouts ``[q_s | k_s | v_s]``.
+
+    A plain tile of the fused axis over ``model`` would hand shard 0
+    the first ``qkv_dim/mp`` columns — mostly q heads, no k/v — so the
+    qkv kernel (and bias, and its per-channel quant scales) is permuted
+    ONCE at engine prep; after the shuffle each shard's contiguous
+    chunk is exactly its own heads in the fused order the model's
+    reshape-split expects.  Applied host-side to the last axis of
+    ``qkv.kernel`` / ``qkv.bias`` (and the kernel's channel scales)."""
+    nhl, nkvl = nh // mp, nkv // mp
+    cols = []
+    for s in range(mp):
+        cols.append(np.arange(s * nhl * hd, (s + 1) * nhl * hd))
+        cols.append(nh * hd + np.arange(s * nkvl * hd, (s + 1) * nkvl * hd))
+        cols.append((nh + nkv) * hd
+                    + np.arange(s * nkvl * hd, (s + 1) * nkvl * hd))
+    return np.concatenate(cols)
 
 
 class ServingEngine:
@@ -111,6 +136,17 @@ class ServingEngine:
         self.kv_bits = cfg.kv_cache_bits
         #: consecutive zero-progress iterations (the serving watchdog)
         self._no_progress = 0
+        # -- (data, model) serving submesh (docs/serving.md
+        # "Tensor-parallel serving"): model shards heads + KV pool +
+        # MLP, data shards the decode slots; 1x1 keeps the legacy
+        # single-device program byte-identical --------------------------
+        self.tp_data_size = cfg.mesh.data
+        self.tp_model_size = cfg.mesh.model
+        self._tp = self.tp_data_size > 1 or self.tp_model_size > 1
+        self.tp_mesh = None
+        self._tp_model = model
+        if self._tp:
+            self._init_tp_mesh()
         with trace_span("serving/kv_quantize", bits=self.kv_bits,
                         blocks=cfg.num_kv_blocks):
             pools = model.init_paged_cache(cfg.num_kv_blocks,
@@ -120,6 +156,20 @@ class ServingEngine:
         self._pool_k, self._pool_v = pools["k"], pools["v"]
         self._pool_ks = pools.get("k_scale")
         self._pool_vs = pools.get("v_scale")
+        if self._tp:
+            # pools shard on the kv_heads axis over `model` (scale
+            # planes ride the same axis) and REPLICATE over `data`: each
+            # chip holds kv_heads/model of every block — per-chip pool
+            # HBM is 1/model of the unsharded pool (kv_pool_bytes)
+            self._pool_k = jax.device_put(
+                self._pool_k, NamedSharding(self.tp_mesh, self._pool_spec))
+            self._pool_v = jax.device_put(
+                self._pool_v, NamedSharding(self.tp_mesh, self._pool_spec))
+            if self.kv_bits:
+                sh = NamedSharding(self.tp_mesh, self._pscale_spec)
+                self._pool_ks = jax.device_put(self._pool_ks, sh)
+                self._pool_vs = jax.device_put(self._pool_vs, sh)
+            self._prep_tp_params()
         logger.info(
             f"serving: paged KV pool {cfg.num_kv_blocks} x "
             f"{self.block_size}-token blocks "
@@ -166,6 +216,25 @@ class ServingEngine:
             "dstpu_serving_kv_bits",
             "KV-cache width: 0 = engine dtype, 8 = int8, 4 = packed "
             "int4").set(self.kv_bits)
+        # serving-mesh shape gauges: per-chip numbers above (pool bytes)
+        # only read honestly next to the mesh they were measured on
+        reg.gauge(
+            "dstpu_mesh_data_size",
+            "serving mesh data-axis size (decode-slot sharding)"
+            ).set(self.tp_data_size)
+        reg.gauge(
+            "dstpu_mesh_model_size",
+            "serving mesh model-axis size (tensor parallelism)"
+            ).set(self.tp_model_size)
+        # per-token per-layer model-axis psum payload (bytes): one psum
+        # on attention+MLP outputs for parallel-residual blocks, two for
+        # serial/post-norm — the `serving/tp_psum` span and
+        # tp_decode_bench report this
+        mc = model.config
+        npsums = 1 if mc.parallel_residual else 2
+        self.tp_psum_bytes_per_token_layer = (
+            0 if self.tp_model_size == 1
+            else mc.d_model * jnp.dtype(mc.dtype).itemsize * npsums)
         self._m_ttft = reg.histogram(
             "dstpu_serving_ttft_seconds",
             "submit -> first token (includes queueing + chunked prefill)")
@@ -218,15 +287,131 @@ class ServingEngine:
         self._hits_polled = 0
         self._evictions_polled = 0
 
+    # ------------------------------------------------------------------
+    # tensor-parallel serving (docs/serving.md "Tensor-parallel serving")
+    # ------------------------------------------------------------------
+    @property
+    def _pool_spec(self) -> P:
+        """KV pools [L, blocks, block, kv_heads, d]: kv_heads over
+        `model`, replicated over `data` (every data shard applies every
+        slot's writes — see the model's gather_rows)."""
+        return P(None, None, None, topo.MODEL_AXIS, None)
+
+    @property
+    def _pscale_spec(self) -> P:
+        """Quant scale planes [L, blocks, block, kv_heads] ride the
+        pools' kv_heads sharding."""
+        return P(None, None, None, topo.MODEL_AXIS)
+
+    def _init_tp_mesh(self) -> None:
+        """Validate the (data, model) request against the model shapes,
+        build the serving submesh over the first data*model devices, and
+        derive the per-shard model view."""
+        dp, mp = self.tp_data_size, self.tp_model_size
+        c = self.model.config
+        if mp > 1:
+            for name, dim in (("kv_heads", c.kv_heads),
+                              ("num_heads", c.num_heads),
+                              ("d_ff", c.ff_dim),
+                              ("vocab_size", c.vocab_size)):
+                if dim % mp:
+                    raise ValueError(
+                        f"serving.mesh.model ({mp}) must divide "
+                        f"{name} ({dim}) — heads/MLP columns/vocab "
+                        f"partition evenly over the model axis")
+        devices = jax.devices()
+        if len(devices) < dp * mp:
+            raise ValueError(
+                f"serving.mesh (data={dp}, model={mp}) needs "
+                f"{dp * mp} devices, have {len(devices)}")
+        from ...runtime.config import MeshConfig
+        self.tp_mesh = topo.build_mesh(MeshConfig(data=dp, model=mp),
+                                       devices=devices[:dp * mp])
+        self._tp_model = self.model.tp_serving_view(
+            mp, topo.MODEL_AXIS,
+            topo.DATA_AXIS if dp > 1 else None)
+        if mp > 1 and getattr(self.engine, "_quantized", False) and \
+                self.engine._qmode != "channel":
+            raise NotImplementedError(
+                "tensor-parallel serving over quantized weights needs "
+                "per-output-channel scales (grouped scales cross shard "
+                "boundaries) — the engine selects channel mode when "
+                "serving.mesh.model > 1 at init_inference time; rebuild "
+                "the engine with the serving mesh in its config")
+
+    def _prep_tp_params(self) -> None:
+        """One-time weight prep for the sharded step: permute the fused
+        qkv columns (kernel + bias + per-channel quant scales) into
+        per-shard-contiguous order, pre-divide the row-parallel out /
+        fc_out biases by the model shard count (the per-layer psum then
+        restores them exactly), and commit everything to the serving
+        submesh under the model's Megatron partition specs."""
+        engine, model = self.engine, self.model
+        c = model.config
+        mp_size = self.tp_model_size
+        specs = model.partition_specs()
+        params = engine.params
+        scales = getattr(engine, "_scales", None)
+        flags = getattr(engine, "_qflags", None)
+        if mp_size > 1:
+            perm = jnp.asarray(
+                _tp_qkv_perm(c.num_heads, c.kv_heads, c.hdim, mp_size))
+
+            def tail_of(path):
+                return tuple(str(getattr(p, "key", "")) for p in path)[-2:]
+
+            def prep(path, leaf):
+                tail = tail_of(path)
+                if tail in (("qkv", "kernel"), ("qkv", "bias")):
+                    return jnp.take(leaf, perm, axis=-1)
+                if tail in (("out", "bias"), ("fc_out", "bias")):
+                    return leaf / mp_size
+                return leaf
+            params = jax.tree_util.tree_map_with_path(prep, params)
+            if scales is not None:
+                def prep_s(path, s, f):
+                    if f and tail_of(path) == ("qkv", "kernel"):
+                        return jnp.take(s, perm, axis=-1)
+                    return s
+                scales = jax.tree_util.tree_map_with_path(
+                    prep_s, scales, flags)
+
+        def put(tree, spec_tree):
+            shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.tp_mesh, s), spec_tree,
+                is_leaf=lambda x: isinstance(x, P))
+            return jax.device_put(tree, shardings)
+
+        self._tp_param_specs = specs
+        self._tp_params = put(params, specs)
+        self._tp_scales = self._tp_scale_specs = None
+        if scales is not None:
+            # per-output-CHANNEL scale vectors shard like their kernel's
+            # last axis (shard-local dequant); placeholder leaves for
+            # unquantized params replicate
+            def sspec(pspec, f, s):
+                nd = len(s.shape)
+                if not f or nd == 0:
+                    return P(*([None] * nd))
+                last = pspec[-1] if len(pspec) else None
+                return P(*([None] * (nd - 1)), last)
+            self._tp_scale_specs = jax.tree_util.tree_map(
+                sspec, specs, flags, scales,
+                is_leaf=lambda x: isinstance(x, P))
+            self._tp_scales = put(scales, self._tp_scale_specs)
+
     @property
     def kv_pool_bytes(self) -> int:
-        """Device HBM held by the paged KV pool — values plus the
-        dequant scale planes when quantized (the
-        ``dstpu_serving_kv_pool_bytes`` gauge)."""
+        """PER-CHIP device HBM held by the paged KV pool — values plus
+        the dequant scale planes when quantized (the
+        ``dstpu_serving_kv_pool_bytes`` gauge).  Under a model-sharded
+        mesh each chip holds ``kv_heads / model`` of every block, so
+        this is 1/model of the global pool (data shards replicate the
+        pool; they add capacity in SLOTS, not bytes)."""
         total = self._pool_k.nbytes + self._pool_v.nbytes
         if self._pool_ks is not None:
             total += self._pool_ks.nbytes + self._pool_vs.nbytes
-        return total
+        return total // self.tp_model_size
 
     # ------------------------------------------------------------------
     # request intake
@@ -301,7 +486,10 @@ class ServingEngine:
     # the one compiled program
     # ------------------------------------------------------------------
     def _build_step(self):
-        engine, model = self.engine, self.model
+        # the TP view shares weights/rotary/block_transform with the
+        # plain model; its per-shard head counts + armed axis names are
+        # what make the SAME body below shard-correct inside shard_map
+        engine, model = self.engine, self._tp_model
 
         def step(params, scales, pool_k, pool_v, pool_ks, pool_vs,
                  tables, lens, dec_tokens, dec_active, chunk_ids,
@@ -337,9 +525,31 @@ class ServingEngine:
         # the quantized pool's scale planes are donated with it (they
         # are rewritten at every scatter, exactly like the values)
         donate = (2, 3, 4, 5) if self.kv_bits else (2, 3)
-        with self.engine.mesh:
+        if not self._tp:
+            with self.engine.mesh:
+                return jax.jit(
+                    step, donate_argnums=donate if self._donate else ())
+        # TP: the same body, shard_mapped over the (data, model) serving
+        # submesh.  Pools/params shard over 'model' (kv_head axis /
+        # column-row tiles), slot-shaped inputs over 'data'; the chunk,
+        # rng and scalars stay replicated so every shard traces the one
+        # identical program (decode_builds == 1 regardless of mesh)
+        d, m = topo.DATA_AXIS, topo.MODEL_AXIS
+        pool_sp = self._pool_spec
+        pscale_sp = self._pscale_spec if self.kv_bits else P()
+        scale_sp = (self._tp_scale_specs
+                    if self._tp_scales is not None else P())
+        in_specs = (self._tp_param_specs, scale_sp,
+                    pool_sp, pool_sp, pscale_sp, pscale_sp,
+                    P(d, None), P(d), P(d), P(d),
+                    P(), P(), P(), P(), P())
+        out_specs = (P(d), P(), P(d), P(),
+                     pool_sp, pool_sp, pscale_sp, pscale_sp, P())
+        sharded = shard_map(step, mesh=self.tp_mesh, in_specs=in_specs,
+                            out_specs=out_specs, axis_names={d, m})
+        with self.tp_mesh:
             return jax.jit(
-                step, donate_argnums=donate if self._donate else ())
+                sharded, donate_argnums=donate if self._donate else ())
 
     # ------------------------------------------------------------------
     # one scheduler iteration
@@ -409,10 +619,20 @@ class ServingEngine:
                 spans.enter_context(
                     trace_span("serving/prefill_chunk", slot=c_slot,
                                start=c_start, tokens=c_len))
+            if self._tp:
+                spans.enter_context(trace_span(
+                    "serving/tp_psum", model=self.tp_model_size,
+                    data=self.tp_data_size,
+                    bytes_per_token_layer=self.tp_psum_bytes_per_token_layer,
+                    layers=self.model.config.num_layers))
+                params = self._tp_params
+                scales = self._tp_scales
+            else:
+                params = self.engine.params
+                scales = getattr(self.engine, "_scales", None)
             (nxt, first, dec_fin, chunk_fin, self._pool_k, self._pool_v,
              self._pool_ks, self._pool_vs, self._rng) = self._step_fn(
-                self.engine.params,
-                getattr(self.engine, "_scales", None),
+                params, scales,
                 self._pool_k, self._pool_v, self._pool_ks,
                 self._pool_vs, tables, lens, dec_tokens,
                 dec_active, chunk_ids,

@@ -78,6 +78,11 @@ class PagedMixedState(NamedTuple):
                    token (== rows already present for that slot)
       chunk_len    int32 scalar — valid chunk tokens (0 = no prefill
                    work this dispatch)
+      tables_g     [S, pages] int32 — the GLOBAL block tables when the
+                   decode slots are sharded over the ``data`` mesh axis
+                   (``block_tables``/``lens`` then hold this shard's
+                   slot rows only, while ``chunk_slot`` stays a global
+                   slot id); None on the single-shard path
       k_scale / v_scale  per-row per-head dequant scales (see
                    :class:`PagedKVCache`; None = unquantized pools)
     """
@@ -89,6 +94,7 @@ class PagedMixedState(NamedTuple):
     chunk_slot: Any
     chunk_start: Any
     chunk_len: Any
+    tables_g: Any = None
     k_scale: Any = None
     v_scale: Any = None
 
@@ -301,6 +307,13 @@ class TransformerLM:
         # to the standard block tree.
         self.block_transform = block_transform or (lambda sp: sp)
         self.mesh = None          # bound by the engine (ring attention)
+        # Manual-collective axis names, set ONLY on the shallow copy
+        # :meth:`tp_serving_view` returns for the tensor-parallel
+        # serving step (inside its shard_map region).  None — the
+        # default on every directly-constructed model — keeps all
+        # non-serving paths (generate, training, pipeline) untouched.
+        self._tp_axis: Optional[str] = None   # 'model': heads/KV/MLP
+        self._dp_axis: Optional[str] = None   # 'data': decode slots
         if config.attention_layers:
             if len(config.attention_layers) != config.num_layers:
                 raise ValueError(
@@ -342,6 +355,44 @@ class TransformerLM:
                           aux_loss_coef=config.moe_aux_loss_coef),
                 d_ff=config.moe_d_ff or config.ff_dim,
                 depth_scale=config.num_layers)
+
+    def tp_serving_view(self, model_shards: int, tp_axis: Optional[str],
+                        dp_axis: Optional[str]) -> "TransformerLM":
+        """Shallow copy of this model whose config carries PER-SHARD
+        head counts — the seam tensor-parallel serving applies through
+        inside its shard_map region (docs/serving.md "Tensor-parallel
+        serving").
+
+        With ``num_heads``/``num_kv_heads`` divided by ``model_shards``
+        (and ``head_dim`` pinned to its resolved value so the division
+        cannot silently change it), every head-count-derived quantity —
+        the fused-qkv split, the rotary reshape, the paged kernels'
+        ``(slot, kv_head, page_group)`` grid — becomes shard-local with
+        NO kernel changes: the kernels are shape-polymorphic and simply
+        see fewer kv heads.  ``tp_axis``/``dp_axis`` arm the manual
+        collectives (`psum` on block outputs, vocab-sharded embed/head,
+        the data-axis KV-row gather); the original model is untouched,
+        so ``generate()`` on the same engine keeps its single-device
+        program.  Rotary tables, ``block_transform`` and ``constrain``
+        are shared by reference."""
+        import copy
+        c = self.config
+        if model_shards > 1:
+            if c.kv_heads % model_shards or c.num_heads % model_shards:
+                raise ValueError(
+                    f"model_shards {model_shards} must divide num_heads "
+                    f"{c.num_heads} and kv_heads {c.kv_heads}")
+            local = dataclasses.replace(
+                c, num_heads=c.num_heads // model_shards,
+                num_kv_heads=c.kv_heads // model_shards,
+                head_dim=c.hdim)
+        else:
+            local = c
+        view = copy.copy(self)
+        view.config = local
+        view._tp_axis = tp_axis if model_shards > 1 else None
+        view._dp_axis = dp_axis
+        return view
 
     # -- init --------------------------------------------------------------
     # Split into per-piece initializers so streamed-parameter paths
@@ -821,19 +872,37 @@ class TransformerLM:
         wd = jnp.where(act, tables[slot, lens // blk] * blk + lens % blk,
                        0)
         # chunk rows: absolute rows base..base+C-1 of the chunk slot's
-        # table (null block for padding past chunk_len)
+        # table (null block for padding past chunk_len).  chunk_slot is
+        # a GLOBAL slot id: with data-sharded slots it indexes the
+        # gathered tables (st.tables_g), which every shard holds in
+        # full — the chunk work itself is replicated over data.
         ci = jnp.arange(c)
         cpos = st.chunk_start + ci
-        ctable = tables[st.chunk_slot]
+        ctable = (tables if st.tables_g is None
+                  else st.tables_g)[st.chunk_slot]
         cpage = jnp.minimum(cpos // blk, npages - 1)
         wc = jnp.where(ci < st.chunk_len, ctable[cpage] * blk + cpos % blk,
                        0)
-        write = jnp.concatenate([wd, wc])
+        dp = self._dp_axis
+
+        def gather_rows(a):
+            # decode-slot sharding: every data shard's pool replica must
+            # apply EVERY slot's new row, so the per-shard decode rows
+            # (and their write indices / quant scales) tile back into
+            # global slot order before the combined scatter — the only
+            # data-axis collective, [B_local, kvh, hd]-sized per layer
+            return a if dp is None else jax.lax.all_gather(
+                a, dp, axis=0, tiled=True)
+        write = jnp.concatenate([gather_rows(wd), wc])
         flat = (nb * blk,) + pool_k.shape[2:]
         if kv_bits:
             from ..ops.quantizer.quantizer import kv_quantize
             kq, ks = kv_quantize(k[0], kv_bits)   # [B+C,kvh,De],[B+C,kvh]
             vq, vs = kv_quantize(v[0], kv_bits)
+            kq = jnp.concatenate([gather_rows(kq[:bsl]), kq[bsl:]])
+            vq = jnp.concatenate([gather_rows(vq[:bsl]), vq[bsl:]])
+            ks = jnp.concatenate([gather_rows(ks[:bsl]), ks[bsl:]])
+            vs = jnp.concatenate([gather_rows(vs[:bsl]), vs[bsl:]])
             sflat = (nb * blk,) + kscale.shape[2:]
             pool_k = pool_k.reshape(flat).at[write].set(
                 kq).reshape(pool_k.shape)
@@ -845,10 +914,14 @@ class TransformerLM:
                 vs).reshape(st.v_scale.shape)
             pk, pv = pool_k, pool_v
         else:
+            kw = k[0].astype(pool_k.dtype)
+            vw = v[0].astype(pool_v.dtype)
+            kw = jnp.concatenate([gather_rows(kw[:bsl]), kw[bsl:]])
+            vw = jnp.concatenate([gather_rows(vw[:bsl]), vw[bsl:]])
             pool_k = pool_k.reshape(flat).at[write].set(
-                k[0].astype(pool_k.dtype)).reshape(pool_k.shape)
+                kw).reshape(pool_k.shape)
             pool_v = pool_v.reshape(flat).at[write].set(
-                v[0].astype(pool_v.dtype)).reshape(pool_v.shape)
+                vw).reshape(pool_v.shape)
             pk = pool_k.astype(q.dtype)
             pv = pool_v.astype(q.dtype)
         from ..ops.transformer.paged_decode_attention import (
@@ -886,22 +959,32 @@ class TransformerLM:
         c = self.config
         norm = self._norm_fn()
         x = self.constrain(x)
+        # Tensor-parallel serving (tp_serving_view): attention heads and
+        # MLP columns are shard-local, so each branch output is a
+        # PARTIAL sum over the model axis — `red` is the one per-layer
+        # collective (row-parallel out/fc_out biases are pre-divided by
+        # the shard count at engine prep, so the psum restores them
+        # exactly); identity everywhere else.
+        if self._tp_axis is not None:
+            red = lambda u: jax.lax.psum(u, self._tp_axis)  # noqa: E731
+        else:
+            red = lambda u: u                               # noqa: E731
         if c.norm_position == "post":
             # BERT family: ln(x + f(x)); ln1 after attention, ln2 after FFN
             a, new_cache = self._attention(bp["attn"], x, cache_kv,
                                            positions, window)
-            x = norm(bp["ln1"], x + a)
-            x = norm(bp["ln2"], x + self._mlp(bp["mlp"], x))
+            x = norm(bp["ln1"], x + red(a))
+            x = norm(bp["ln2"], x + red(self._mlp(bp["mlp"], x)))
         elif c.parallel_residual:
             a, new_cache = self._attention(bp["attn"], norm(bp["ln1"], x),
                                            cache_kv, positions, window)
             m = self._mlp(bp["mlp"], norm(bp["ln2"], x))
-            x = x + a + m
+            x = x + red(a + m)
         else:
             a, new_cache = self._attention(bp["attn"], norm(bp["ln1"], x),
                                            cache_kv, positions, window)
-            x = x + a
-            x = x + self._mlp(bp["mlp"], norm(bp["ln2"], x))
+            x = x + red(a)
+            x = x + red(self._mlp(bp["mlp"], norm(bp["ln2"], x)))
         return self.constrain(x), new_cache
 
     def _moe_block(self, bp, x, cache_kv=None, positions=None, rng=None,
@@ -1043,7 +1126,23 @@ class TransformerLM:
         """Shared embedding path: word (+ position, + token-type) embeds,
         then the optional embedding layernorm (BLOOM, BERT)."""
         c = self.config
-        x = L.embedding_apply(params["embed"], input_ids, c.dtype)
+        if self._tp_axis is not None:
+            # vocab-sharded table [V/mp, D] (the Megatron layout
+            # partition_specs declares): each shard looks up the ids it
+            # owns, masks the rest to zero rows, and one psum rebuilds
+            # the full word embedding; position/type tables and the
+            # embedding layernorm are replicated and applied AFTER the
+            # psum so they land exactly once
+            vloc = params["embed"]["embedding"].shape[0]
+            lo = jax.lax.axis_index(self._tp_axis) * vloc
+            local = input_ids - lo
+            mine = (local >= 0) & (local < vloc)
+            x = L.embedding_apply(params["embed"],
+                                  jnp.where(mine, local, 0), c.dtype)
+            x = jax.lax.psum(jnp.where(mine[..., None], x, 0),
+                             self._tp_axis)
+        else:
+            x = L.embedding_apply(params["embed"], input_ids, c.dtype)
         if c.pos_embedding == "learned":
             if positions is None:
                 positions = jnp.arange(input_ids.shape[1])[None, :]
@@ -1069,12 +1168,20 @@ class TransformerLM:
             logits = L.embedding_attend(params["embed"], h)
             return logits + mh["bias"].astype(logits.dtype)
         if c.tie_embeddings:
-            return L.embedding_attend(params["embed"], x)
-        logits = jnp.einsum("...d,dv->...v", x,
-                            params["lm_head"]["kernel"].astype(x.dtype),
-                            preferred_element_type=jnp.float32)
-        if "bias" in params["lm_head"]:     # GPT-J carries a head bias
-            logits = logits + params["lm_head"]["bias"]
+            logits = L.embedding_attend(params["embed"], x)
+        else:
+            logits = jnp.einsum("...d,dv->...v", x,
+                                params["lm_head"]["kernel"].astype(x.dtype),
+                                preferred_element_type=jnp.float32)
+            if "bias" in params["lm_head"]:  # GPT-J carries a head bias
+                logits = logits + params["lm_head"]["bias"]
+        if self._tp_axis is not None:
+            # vocab-sharded head (tied table [V/mp, D] or lm_head kernel
+            # (None, 'model')): local [.., V/mp] logits tile back into
+            # the full vocab — shard order IS vocab order, so greedy
+            # argmax over the gather matches the single-device program
+            logits = jax.lax.all_gather(logits, self._tp_axis, axis=-1,
+                                        tiled=True)
         return logits
 
     def hidden_states_and_aux(self, params, input_ids, rng=None, train=True,
@@ -1228,8 +1335,15 @@ class TransformerLM:
         positions = jnp.concatenate([lens, cpos])[None]    # [1, B+C]
         ids = jnp.concatenate([dec_tokens, chunk_ids])[None]
         x = self._embed_tokens(params, ids, positions=positions)
+        # data-sharded decode slots: the chunk indexes a GLOBAL slot, so
+        # gather the full block tables ONCE here (they are loop
+        # constants — the layer scan reuses the gathered copy, it is not
+        # a per-layer collective)
+        tables_g = (None if self._dp_axis is None else
+                    jax.lax.all_gather(tables, self._dp_axis, axis=0,
+                                       tiled=True))
         st_args = (tables, lens, dec_active, chunk_slot, chunk_start,
-                   chunk_len)
+                   chunk_len, tables_g)
 
         def scan_fn(carry, xs):
             bp, *pools = xs
@@ -1253,7 +1367,13 @@ class TransformerLM:
         logits = self._project(params,
                                jnp.concatenate([x[0, :bsl], last])[None])
         new_lens = lens + (dec_active > 0).astype(lens.dtype)
-        new_lens = new_lens.at[chunk_slot].add(chunk_len)
+        # with data-sharded slots `lens` is this shard's rows and
+        # chunk_slot is global: translate to the local row, dropping the
+        # update on shards that don't own the chunk slot (the serving
+        # engine recomputes lens host-side every dispatch either way)
+        cs = (chunk_slot if self._dp_axis is None else
+              chunk_slot - jax.lax.axis_index(self._dp_axis) * bsl)
+        new_lens = new_lens.at[cs].add(chunk_len, mode="drop")
         new_cache = {"k": pools[0], "v": pools[1], "block_tables": tables,
                      "lens": new_lens}
         if quant:

@@ -149,6 +149,15 @@ SERVING_DEFAULT_DEADLINE_S_DEFAULT = 0.0
 # same pool HBM budget holds that many more tokens (docs/serving.md
 # "Quantized KV cache")
 SERVING_KV_CACHE_BITS_DEFAULT = 0
+# serving mesh (docs/serving.md "Tensor-parallel serving"): the decode /
+# chunked-prefill program shards over a (data, model) submesh —
+# ``model`` splits attention heads, the paged KV pool (+ scale planes)
+# and the MLP column/row-wise (per-chip pool bytes / model); ``data``
+# partitions the decode slots (model * data chips serve data x the
+# slots).  1 x 1 keeps the single-device program byte-identical to the
+# pre-TP path.
+SERVING_MESH_DATA_DEFAULT = 1
+SERVING_MESH_MODEL_DEFAULT = 1
 
 # The reference's inference-route keys (ROUTE_TRAIN/EVAL/PREDICT/ENCODE)
 # and a top-level MOE block key were carried here for five PRs without a

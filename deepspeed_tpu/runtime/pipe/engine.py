@@ -534,29 +534,42 @@ class PipelineEngine(DeepSpeedEngine):
                         micro_batches=self.micro_batches):
             return self._build_train_step_traced()
 
+    def _pipeline_gpipe_value_and_grad(self, params, ids, scale):
+        """Manual over 'pipe'. Autodiff runs INSIDE the region: legacy
+        jax (0.4.x) cannot transpose the shard_map primitive itself
+        (scalar residuals trip ``_SpecError`` in the partial-eval /
+        transpose pipeline), so the gpipe path mirrors 1F1B's structure
+        — grads are taken per stage and the cross-stage contributions
+        of the replicated leaves (embed/head/ln_f) psummed here, while
+        block grads stay pipe-local like the params themselves.
+        fp16: loss is scaled BEFORE autodiff so small grads survive the
+        half-precision backward (reference FP16_Optimizer.backward,
+        fp16/fused_optimizer.py); the caller divides the loss back out.
+        """
+        def loss_fn(p):
+            return self._pipeline_loss(self._cast_for_compute(p),
+                                       ids) * scale
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        psum = partial(jax.lax.psum, axis_name=topo.PIPE_AXIS)
+        grads = {k: (v if k == "blocks"
+                     else jax.tree_util.tree_map(psum, v))
+                 for k, v in grads.items()}
+        return loss, grads
+
     def _build_train_step_traced(self):
         if self.schedule == "1f1b":
             return self._build_1f1b_train_step()
-        auto_axes = frozenset(a for a in self.mesh.axis_names
-                              if a != topo.PIPE_AXIS)
         pipe_specs = self.adapter.pipe_specs()
-        sharded_loss = shard_map(
-            self._pipeline_loss, mesh=self.mesh,
-            in_specs=(pipe_specs, P()), out_specs=P(),
+        sharded = shard_map(
+            self._pipeline_gpipe_value_and_grad, mesh=self.mesh,
+            in_specs=(pipe_specs, P(), P()),
+            out_specs=(P(), pipe_specs),
             axis_names={topo.PIPE_AXIS})
 
         def step_fn(state, batch):
             ids = batch["input_ids"]        # [M, mb, T]
-            # fp16: scale the loss BEFORE autodiff so small grads survive the
-            # half-precision backward; _apply_grads divides the sum back out
-            # (reference FP16_Optimizer.backward, fp16/fused_optimizer.py).
             scale = self._current_scale(state)
-
-            def loss_of(params):
-                return sharded_loss(self._cast_for_compute(params),
-                                    ids) * scale
-
-            loss, grads = jax.value_and_grad(loss_of)(state["params"])
+            loss, grads = sharded(state["params"], ids, scale)
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32), grads)
             new_state, metrics = self._apply_grads(state, grads, 1.0)

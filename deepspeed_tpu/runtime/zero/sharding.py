@@ -27,13 +27,13 @@ leaves with no such dimension stay replicated (the analogue of the reference's
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ...parallel.topology import DATA_AXIS
+from ...parallel.topology import DATA_AXIS, DCN_DATA_AXIS
 
 
 def _spec_entries(spec: Optional[P], ndim: int) -> list:
@@ -55,17 +55,22 @@ def _used_axes(entries) -> set:
 
 
 def shard_over_axis(spec: Optional[P], shape: Tuple[int, ...], mesh: Mesh,
-                    axis: str = DATA_AXIS,
+                    axis: Union[str, Sequence[str]] = DATA_AXIS,
                     exclude_dims: Sequence[int] = (),
                     min_size: int = 0) -> P:
-    """Add `axis` to the largest free, divisible dim of `shape`; no-op if the
-    axis is already used, has size 1, or no dim qualifies (→ replicated over
-    `axis`, the small-param persistence case)."""
-    axis_size = mesh.shape.get(axis, 1)
-    if axis_size <= 1:
-        return spec if spec is not None else P(*([None] * len(shape)))
+    """Add `axis` (one mesh axis name, or a sequence sharded jointly —
+    the multi-axis data-parallel product, e.g. ``(dcn_data, data)``) to
+    the largest free dim of `shape` divisible by the combined axis size;
+    no-op if every requested axis is already used or size 1, or no dim
+    qualifies (→ replicated, the small-param persistence case)."""
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
     entries = _spec_entries(spec, len(shape))
-    if axis in _used_axes(entries):
+    # an axis already claimed by `spec` (or trivial in this mesh) drops
+    # out of the joint product rather than vetoing the whole shard
+    axes = tuple(a for a in axes
+                 if mesh.shape.get(a, 1) > 1 and a not in _used_axes(entries))
+    axis_size = int(np.prod([mesh.shape[a] for a in axes])) if axes else 1
+    if axis_size <= 1:
         return P(*entries)
     if int(np.prod(shape)) < min_size:
         return P(*entries)
@@ -88,10 +93,10 @@ def shard_over_axis(spec: Optional[P], shape: Tuple[int, ...], mesh: Mesh,
         return P(*entries)
     e = entries[best]
     if e is None:
-        entries[best] = axis
+        entries[best] = axes if len(axes) > 1 else axes[0]
     else:
         names = tuple(e) if isinstance(e, (tuple, list)) else (e,)
-        entries[best] = names + (axis,)
+        entries[best] = names + axes
     return P(*entries)
 
 
@@ -135,7 +140,13 @@ class ZeroShardingPolicy:
         def f(path, spec, shp):
             shape = tuple(getattr(shp, "shape", shp))
             excl = (0,) if (exclude_scan_dim and self._is_scan_path(path)) else ()
-            return shard_over_axis(spec, shape, self.mesh, DATA_AXIS,
+            # partition over the FULL data-parallel product — on a
+            # multi-slice mesh `data` alone is only the intra-slice
+            # replicas, and stopping there leaves a dcn_data-factor of
+            # the memory saving on the table (specs come from the mesh,
+            # never from jax.device_count())
+            return shard_over_axis(spec, shape, self.mesh,
+                                   (DCN_DATA_AXIS, DATA_AXIS),
                                    exclude_dims=excl,
                                    min_size=self.min_partition_size)
         return jax.tree_util.tree_map_with_path(

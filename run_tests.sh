@@ -57,7 +57,7 @@ for f in tests/unit/test_*.py; do
     continue
   fi
   if [[ "$f" == *test_resilience.py || "$f" == *test_observability.py \
-        || "$f" == *test_serving.py ]]; then
+        || "$f" == *test_serving.py || "$f" == *test_serving_tp.py ]]; then
     continue   # each runs once in its marker sweep below, not twice
   fi
   echo "=== $f"
@@ -109,6 +109,24 @@ if [[ -z "$FILTER" || "inference" == *"$FILTER"* || "serving" == *"$FILTER"* ]];
     PASSED=$((PASSED + 1))
   else
     FAILED+=("pytest -m inference")
+  fi
+fi
+
+# Multichip-serving sweep: the tensor-parallel suite runs the full
+# mesh matrix (model {1,2,4} x data = 8/model x kv bits {0,8},
+# including the `slow`-marked cases tier-1 skips) on the 8-virtual-
+# device CPU mesh the conftest forces via
+# --xla_force_host_platform_device_count=8 — token-exact streams vs
+# generate(), per-chip pool-bytes pins, decode_builds==1, allocator
+# fuzz at sharded pool size (docs/serving.md "Tensor-parallel
+# serving").
+if [[ -z "$FILTER" || "multichip" == *"$FILTER"* || "serving" == *"$FILTER"* ]]; then
+  echo "=== multichip-serving sweep (tests/unit/test_serving_tp.py, 8-device CPU mesh)"
+  if JAX_PLATFORMS=cpu python -m pytest tests/unit/test_serving_tp.py \
+       -q --tb=short ${EXTRA_PYTEST_ARGS:-}; then
+    PASSED=$((PASSED + 1))
+  else
+    FAILED+=("multichip-serving (test_serving_tp.py)")
   fi
 fi
 
