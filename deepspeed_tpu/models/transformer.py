@@ -177,6 +177,12 @@ class TransformerConfig:
     # tokens (0 = off) keeps only [B,chunk,V] live, rematerializing per chunk
     # in backward.
     loss_chunk: int = 512
+    # Analytic custom-VJP loss head (ops/transformer/fused_loss.py): the
+    # backward recomputes chunk logits in-VJP and forms softmax−onehot
+    # directly instead of materializing the [B,T,V] logit cotangent.
+    # Ignored (autodiff path) for the MLM head and the vocab-sharded TP
+    # head, which need the logits cotangent plumbing.
+    fused_loss_head: bool = True
     # -- MoE (reference deepspeed/moe/layer.py:15 MoE surface) --------------
     moe_num_experts: int = 0           # 0 → dense model
     moe_freq: int = 2                  # 1 = every layer, 2 = every other
@@ -642,7 +648,10 @@ class TransformerLM:
             # block pool + batched paged-attention kernel
             return self._paged_attention(p, q, k, v, cache_kv, b, t, nh, hd)
         if cache_kv is None and c.attn_impl in ("ring", "ulysses",
-                                                "blocksparse", "flash"):
+                                                "blocksparse"):
+            # the flash kernel folds GQA via its k/v index maps and is NOT
+            # in this list — expanding would multiply its HBM traffic by
+            # the group size for nothing
             k, v = expand_kv(k), expand_kv(v)
         if cache_kv is None and c.attn_impl in ("ring", "ulysses"):
             from ..parallel.topology import SEQUENCE_AXIS
@@ -682,10 +691,13 @@ class TransformerLM:
             o = o.reshape(b, t, nh * hd)
             return L.dense_apply(p["out"], o), None
         if cache_kv is None and c.attn_impl == "flash" and \
-                c.pos_embedding != "alibi":
+                c.pos_embedding != "alibi" and window is None:
             from ..ops.transformer.flash_attention import (
                 flash_attention_bthd, supports)
             if supports(q.shape[1], k.shape[1]):
+                # k/v go in at kv-head width; ragged lengths are masked
+                # in-kernel (ceil grid), so mid-sized odd sequences no
+                # longer fall back to the O(T²) XLA path
                 o = flash_attention_bthd(q, k, v, causal=c.causal)
                 o = o.reshape(b, t, nh * hd)
                 return L.dense_apply(p["out"], o), None
@@ -1461,8 +1473,31 @@ class TransformerLM:
         """Mean masked NLL from final hidden states ([B,T,D]) — the loss
         HEAD alone, exposed so it can be timed/attributed separately from
         the trunk (bench.py phase breakdown)."""
-        chunk = self.config.loss_chunk
+        c = self.config
+        chunk = c.loss_chunk
         t = labels.shape[1]
+        if c.fused_loss_head and not c.mlm_head and self._tp_axis is None:
+            # Analytic fused head: backward recomputes chunk logits and
+            # forms (softmax − onehot)·mask·ḡ in-VJP — no [B,T,V] logit
+            # cotangent in HBM (ops/transformer/fused_loss.py).
+            from ..ops.transformer.fused_loss import fused_linear_xent
+            if c.tie_embeddings:
+                w, bias, tw = params["embed"]["embedding"], None, True
+            else:
+                w = params["lm_head"]["kernel"]
+                bias = params["lm_head"].get("bias")
+                tw = False
+            b = labels.shape[0]
+            rows = b * t
+            # chunk in whole token columns so the row chunking matches the
+            # checkpointed path's [B, chunk] tiles
+            row_chunk = b * chunk if (chunk and t > chunk
+                                      and t % chunk == 0) else 0
+            tot, cnt = fused_linear_xent(
+                x.reshape(rows, x.shape[-1]), w, labels.reshape(rows),
+                None if mask is None else mask.reshape(rows),
+                bias=bias, transpose_w=tw, chunk=row_chunk)
+            return tot / jnp.maximum(cnt, 1.0)
         if chunk and t > chunk and t % chunk == 0:
             # Chunked CE: never materialize [B,T,V]; per chunk the projection
             # + logsumexp recompute in backward (jax.checkpoint).

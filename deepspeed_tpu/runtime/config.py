@@ -442,6 +442,59 @@ class ObservabilityConfig(ConfigModel):
         return self.tracing.enabled or self.metrics.enabled
 
 
+#: remat policies the model's ``_remat`` accepts (models/transformer.py);
+#: kept here so the config rejects a typo'd policy at parse time, before
+#: the engine rebuilds the model with it
+TRAINING_REMAT_POLICIES = ("none", "full", "dots_saveable",
+                           "dots_no_batch", "nothing_saveable",
+                           "host_offload")
+
+
+class TrainingConfig(ConfigModel):
+    """``training`` block (docs/training_perf.md).
+
+    Overrides of the model-side hot-path knobs the autotuner searches.
+    Every field defaulting to None means "keep the model config's
+    setting"; a non-None value makes the ENGINE rebuild the model with
+    that knob at initialize time, so a tuned best-config JSON is
+    self-contained — no caller-side model surgery needed to apply it."""
+    # jax.checkpoint policy applied per transformer block
+    remat: Optional[str] = C.TRAINING_REMAT_DEFAULT
+    # analytic custom-VJP loss head (ops/transformer/fused_loss.py):
+    # backward recomputes chunk logits and forms softmax−onehot in-VJP
+    # instead of materializing [B,T,V] logit cotangents
+    fused_loss_head: Optional[bool] = C.TRAINING_FUSED_LOSS_HEAD_DEFAULT
+    # tokens per loss chunk (model config ``loss_chunk``); 0 = dense
+    loss_chunk: Optional[int] = C.TRAINING_LOSS_CHUNK_DEFAULT
+    # donate batch buffers into the jitted step alongside engine state
+    # (runtime/engine.py _build_train_step). Off by default: bench and
+    # autotune loops re-feed the same device batch, which donation
+    # would invalidate.
+    donate_batch: bool = C.TRAINING_DONATE_BATCH_DEFAULT
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.remat is not None and \
+                self.remat not in TRAINING_REMAT_POLICIES:
+            raise ValueError(
+                f"training.remat must be one of "
+                f"{list(TRAINING_REMAT_POLICIES)}, got {self.remat!r}")
+        if self.loss_chunk is not None and self.loss_chunk < 0:
+            raise ValueError(
+                f"training.loss_chunk must be >= 0 (0 = dense), got "
+                f"{self.loss_chunk}")
+        return self
+
+    def model_overrides(self) -> Dict[str, Any]:
+        """The non-None model-config overrides this block carries."""
+        out: Dict[str, Any] = {}
+        for key in ("remat", "fused_loss_head", "loss_chunk"):
+            val = getattr(self, key)
+            if val is not None:
+                out[key] = val
+        return out
+
+
 # ---------------------------------------------------------------------------
 # Master config
 # ---------------------------------------------------------------------------
@@ -536,6 +589,7 @@ class DeepSpeedConfig:
         self.comms_config = CommsConfig(**g(C.COMMS_LOGGER, {}))
         self.resilience = ResilienceConfig(**g(C.RESILIENCE, {}))
         self.observability = ObservabilityConfig(**g(C.OBSERVABILITY, {}))
+        self.training = TrainingConfig(**g(C.TRAINING, {}))
 
         # Late imports to avoid cycles; these blocks are parsed by their
         # subsystems on first use.

@@ -67,6 +67,7 @@ TENSOR_PARALLEL = "tensor_parallel"
 RESILIENCE = "resilience"
 COMMS_LOGGER = "comms_logger"
 OBSERVABILITY = "observability"
+TRAINING = "training"
 
 #############################################
 # Defaults
@@ -158,6 +159,19 @@ SERVING_KV_CACHE_BITS_DEFAULT = 0
 # pre-TP path.
 SERVING_MESH_DATA_DEFAULT = 1
 SERVING_MESH_MODEL_DEFAULT = 1
+
+# Training hot-path block (``training`` — runtime/config.py
+# TrainingConfig, docs/training_perf.md): per-run overrides of the model
+# knobs the autotuner searches, so a tuned config JSON is self-contained
+# and the engine — not the caller — rebuilds the model with the winning
+# remat/loss-head settings.  None = keep whatever the model config says.
+TRAINING_REMAT_DEFAULT = None          # none|full|dots_saveable|...
+TRAINING_FUSED_LOSS_HEAD_DEFAULT = None   # True/False; None = model's
+TRAINING_LOSS_CHUNK_DEFAULT = None     # tokens per loss chunk; 0 = dense
+# donate the batch buffers into the jitted train step in addition to the
+# engine state. Off by default: benches and the autotuner re-feed the
+# same device batch across steps, which donation would invalidate.
+TRAINING_DONATE_BATCH_DEFAULT = False
 
 # The reference's inference-route keys (ROUTE_TRAIN/EVAL/PREDICT/ENCODE)
 # and a top-level MOE block key were carried here for five PRs without a

@@ -81,9 +81,14 @@ class DeepSpeedEngine:
                  param_specs: Any = None,
                  rng: Optional[jax.Array] = None,
                  dont_init: bool = False):
-        self.model = model
         self._config = (config if isinstance(config, DeepSpeedConfig)
                         else DeepSpeedConfig(config or {}))
+        # the ``training`` block carries model-side hot-path knobs
+        # (remat policy, fused loss head, loss chunking) — apply them by
+        # rebuilding the model BEFORE anything binds model.loss, so a
+        # tuned config JSON alone changes the compiled step program
+        model = self._apply_training_overrides(model)
+        self.model = model
         if self._config.resilience.fault_injection:
             # config-driven fault plans arm the process-global injector
             # (runtime/resilience; env DSTPU_FAULTS plans merge on top)
@@ -675,10 +680,47 @@ class DeepSpeedEngine:
             metrics["loss"] = lsum / (scale * gas)
             return new_state, metrics
 
+        # Donated-buffer audit (ISSUE 11): state in / state out aliases the
+        # params + opt leaves — always safe and always donated (the step
+        # would otherwise hold 2x model state live across the update).
+        # The BATCH is only donatable when the caller feeds fresh device
+        # buffers every step; bench/autotune loops re-feed one batch, so
+        # it is opt-in via training.donate_batch. The offload grad fn
+        # (_build_offload_grad_fn) donates NOTHING: its state stays live
+        # for the host optimizer sweep and its batch is reused.
+        donate = (0, 1) if self._config.training.donate_batch else (0,)
         with self.mesh:
-            self._train_step_fn = jax.jit(step_fn, donate_argnums=(0,))
+            self._train_step_fn = jax.jit(step_fn, donate_argnums=donate)
         _count_jit_build()
         return self._train_step_fn
+
+    def _apply_training_overrides(self, model):
+        """Rebuild ``model`` with the ``training`` block's model-side
+        overrides (remat / fused_loss_head / loss_chunk). Mirrors
+        Autotuner.apply_best: dataclass-config models are reconstructed
+        via dataclasses.replace; models without one reject overrides
+        loudly instead of silently ignoring a tuned config."""
+        overrides = self._config.training.model_overrides()
+        if not overrides:
+            return model
+        import dataclasses as _dc
+        mcfg = getattr(model, "config", None)
+        if mcfg is None or not _dc.is_dataclass(mcfg):
+            raise ValueError(
+                f"config has training overrides {sorted(overrides)} but "
+                f"{type(model).__name__} has no dataclass .config to "
+                f"rebuild from")
+        applicable = {k: v for k, v in overrides.items()
+                      if hasattr(mcfg, k)}
+        missing = set(overrides) - set(applicable)
+        if missing:
+            raise ValueError(
+                f"training overrides {sorted(missing)} have no matching "
+                f"field on {type(mcfg).__name__}")
+        if all(getattr(mcfg, k) == v for k, v in applicable.items()):
+            return model
+        return type(model)(_dc.replace(mcfg, **applicable),
+                           getattr(model, "constrain", None))
 
     # ------------------------------------------------------------------
     # 1-bit Adam: shard_map'd step over the compression axis

@@ -57,7 +57,8 @@ for f in tests/unit/test_*.py; do
     continue
   fi
   if [[ "$f" == *test_resilience.py || "$f" == *test_observability.py \
-        || "$f" == *test_serving.py || "$f" == *test_serving_tp.py ]]; then
+        || "$f" == *test_serving.py || "$f" == *test_serving_tp.py \
+        || "$f" == *test_training_perf.py ]]; then
     continue   # each runs once in its marker sweep below, not twice
   fi
   echo "=== $f"
@@ -92,6 +93,20 @@ if [[ -z "$FILTER" || "observability" == *"$FILTER"* ]]; then
     PASSED=$((PASSED + 1))
   else
     FAILED+=("pytest -m observability")
+  fi
+fi
+
+# Training-perf / autotune sweep: remat-override parity, fused loss
+# head vs autodiff, the shared phase-roofline engine, and the 2-point
+# CPU smoke search whose best-config JSON must round-trip through
+# DeepSpeedConfig (pytest.ini `autotune` marker; docs/training_perf.md).
+if [[ -z "$FILTER" || "autotune" == *"$FILTER"* || "training" == *"$FILTER"* ]]; then
+  echo "=== training-perf/autotune marker sweep (pytest -m autotune)"
+  if JAX_PLATFORMS=cpu python -m pytest tests/unit/test_training_perf.py \
+       -m autotune -q --tb=short ${EXTRA_PYTEST_ARGS:-}; then
+    PASSED=$((PASSED + 1))
+  else
+    FAILED+=("pytest -m autotune")
   fi
 fi
 

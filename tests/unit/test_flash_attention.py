@@ -44,11 +44,26 @@ class TestForward:
                                    np.asarray(ref_attn(q, k, v)),
                                    atol=2e-5)
 
-    def test_rejects_ragged(self):
+    def test_ragged_matches_xla(self):
+        """Non-block-divisible length runs in-kernel (ceil grid + tail
+        masking) instead of raising — the old divisibility gate forced
+        every odd training length onto the O(T²) XLA fallback."""
         q, k, v = make_qkv(1, 1536, 2, 32)
-        assert not supports(1536, 1536)
-        with pytest.raises(ValueError, match="divisible"):
-            flash_attention_bthd(q, k, v)
+        assert supports(1536, 1536)
+        out = flash_attention_bthd(q, k, v)  # 1536 % 1024 != 0
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(ref_attn(q, k, v)),
+                                   atol=2e-5)
+
+    def test_gqa_matches_xla(self):
+        """k/v enter at kv-head width; the kernel folds the group via its
+        index maps (no jnp.repeat expansion)."""
+        q, _, _ = make_qkv(2, 256, 8, 32)
+        _, k, v = make_qkv(2, 256, 2, 32, seed=7)
+        out = flash_attention_bthd(q, k, v, block_q=128, block_k=128)
+        ref = ref_attn(q, jnp.repeat(k, 4, axis=2), jnp.repeat(v, 4, axis=2))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
 
 
 class TestBackward:
@@ -84,6 +99,23 @@ class TestBackward:
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-5)
+
+    @pytest.mark.slow
+    def test_ragged_gqa_grads_match_xla(self):
+        """Hardest combination in one case: ragged length (tail-masked
+        ceil grid) + GQA (grouped dkv grid) + causal, through the
+        two-pass backward."""
+        q, _, _ = make_qkv(2, 160, 4, 32, seed=4)
+        _, k, v = make_qkv(2, 160, 2, 32, seed=5)
+        fa = lambda q, k, v: flash_attention_bthd(  # noqa: E731
+            q, k, v, block_q=128, block_k=128)
+        ref = lambda q, k, v: ref_attn(  # noqa: E731
+            q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2))
+        g_fa = self._grads(fa, q, k, v)
+        g_ref = self._grads(ref, q, k, v)
+        for a, b in zip(g_fa, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4)
 
     @pytest.mark.slow
     def test_noncausal(self):
