@@ -797,6 +797,108 @@ def infinity_bench(h2d_gbps: float, d2h_gbps: float):
         "projections": projections}), flush=True)
 
 
+def multi_tenant_replay_bench(slots: int = 4, new: int = 16,
+                              rounds: int = 60, spec_k: int = 1,
+                              **model_kw):
+    """Bursty 3-tenant replay through the SLO frontend (docs/serving.md
+    "Sampling, streaming & multi-tenant SLOs"): an interactive tenant
+    (4x weight, TTFT SLO) trickles short sampled prompts, a standard
+    tenant submits steadily, and a batch tenant dumps two long-prompt
+    bursts into a bounded queue — with the speculative lane armed.
+    Reports per-tenant p50/p99 TTFT and inter-token latency, shed /
+    timeout rates, and the draft acceptance rate: the fairness
+    instrument — under the bursts the interactive percentiles should
+    hold while the batch tenant absorbs the queueing and the sheds."""
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.inference.serving import (ServingFrontend,
+                                                 TenantSpec)
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+
+    cfg = gpt2_config("125m", dtype=jnp.float32, **model_kw)
+    eng = ds.init_inference(TransformerLM(cfg), config={
+        "dtype": "float32", "max_out_tokens": 128, "temperature": 0.0,
+        "replace_with_kernel_inject": False,
+        "serving": {"enabled": True, "kv_block_size": 8,
+                    "num_kv_blocks": 64, "max_batch_slots": slots,
+                    "prefill_chunk_tokens": 32, "max_queue_depth": 6,
+                    "spec_k": spec_k}})
+    draft = TransformerLM(gpt2_config(
+        "125m", dtype=jnp.float32, **dict(model_kw, num_layers=1)))
+    srv = eng.serving_engine(draft_model=draft,
+                             draft_params=draft.init(jax.random.PRNGKey(1)))
+    fe = ServingFrontend(srv)
+    fe.register(TenantSpec("interactive", weight=4.0, ttft_slo_s=0.5))
+    fe.register(TenantSpec("standard", weight=1.0))
+    fe.register(TenantSpec("batch", weight=1.0, max_queue_share=0.5))
+    tenants = ("interactive", "standard", "batch")
+    ttft = {t: [] for t in tenants}
+    itl = {t: [] for t in tenants}
+
+    def hook(ev):
+        if ev.token is None or ev.tenant not in ttft:
+            return
+        if ev.index == 0:
+            ttft[ev.tenant].append(ev.time_s - ev.request.submit_time)
+        elif ev.prev_time_s is not None:
+            itl[ev.tenant].append(ev.time_s - ev.prev_time_s)
+
+    srv.token_hooks.append(hook)
+    fe.submit([1, 2, 3], max_new_tokens=4)      # warm the compile
+    srv.run()
+    rs = np.random.RandomState(7)
+    reqs = {t: [] for t in tenants}
+
+    def sub(tenant, plen, **kw):
+        p = rs.randint(0, cfg.vocab_size, (plen,)).tolist()
+        reqs[tenant].append(fe.submit(p, tenant=tenant,
+                                      max_new_tokens=new, **kw))
+
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        if r % 3 == 0:
+            sub("interactive", int(rs.randint(4, 9)),
+                temperature=0.7, top_k=16, seed=100 + r)
+        if r % 5 == 0:
+            sub("standard", int(rs.randint(10, 14)))
+        if r in (2, rounds // 2):               # the bursts
+            for _ in range(5):
+                sub("batch", int(rs.randint(20, 25)))
+        srv.step()
+    srv.run()
+    dt = time.perf_counter() - t0
+
+    def pcts(xs):
+        if not xs:
+            return {"p50_ms": None, "p99_ms": None}
+        return {"p50_ms": round(float(np.percentile(xs, 50)) * 1e3, 2),
+                "p99_ms": round(float(np.percentile(xs, 99)) * 1e3, 2)}
+
+    sc = srv.spec_counts
+    per_tenant = {}
+    for t in tenants:
+        rs_t = reqs[t]
+        shed = sum(r.status.value == "shed" for r in rs_t)
+        timed = sum(r.status.value == "timed_out" for r in rs_t)
+        per_tenant[t] = {
+            "requests": len(rs_t),
+            "ttft": pcts(ttft[t]), "inter_token": pcts(itl[t]),
+            "shed_rate": round(shed / max(len(rs_t), 1), 3),
+            "timeout_rate": round(timed / max(len(rs_t), 1), 3),
+            "tokens": sum(len(r.output) for r in rs_t)}
+    print(json.dumps({
+        "metric": "multi_tenant_replay",
+        "value": round(sum(pt["tokens"] for pt in per_tenant.values())
+                       / dt, 1),
+        "unit": "tokens/s", "slots": slots, "rounds": rounds,
+        "tenants": per_tenant, "spec_k": spec_k,
+        "spec_proposed": sc["proposed"], "spec_accepted": sc["accepted"],
+        "spec_acceptance_rate": round(
+            sc["accepted"] / max(sc["proposed"], 1), 3),
+        "decode_builds": srv.decode_builds}), flush=True)
+
+
 def main():
     import jax
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -808,6 +910,7 @@ def main():
         hbm = hbm_ceiling_probe()
         decode16k_bench(hbm_gbps=hbm)
         serving_decode_bench()
+        multi_tenant_replay_bench(spec_k=3)
         prefix_cache_bench()
         paged_decode_attention_bench()
         paged_decode_roofline_sweep(hbm)
@@ -823,6 +926,8 @@ def main():
         # the (data, model) serving sweep runs on the forced 8-device
         # CPU mesh — mesh-shape coverage, not absolute throughput
         tp_decode_bench()
+        multi_tenant_replay_bench(num_layers=2, d_model=64, num_heads=4,
+                                  vocab_size=256, max_seq_len=128)
 
 
 if __name__ == "__main__":

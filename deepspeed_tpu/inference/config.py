@@ -92,6 +92,13 @@ class ServingConfig(ConfigModel):
     # RUNNING requests (terminal status TIMED_OUT); 0 = none;
     # submit(deadline_s=...) overrides per request
     default_deadline_s: float = C.SERVING_DEFAULT_DEADLINE_S_DEFAULT
+    # speculative decoding draft depth: tokens the draft model proposes
+    # per slot per iteration when a draft model is armed
+    # (serving_engine(draft_model=...)); ignored without a draft.  The
+    # verified round emits 1..spec_k+1 tokens per iteration with EXACT
+    # token equivalence to plain decode under the same key
+    # (docs/serving.md "Speculative decoding")
+    spec_k: int = C.SERVING_SPEC_K_DEFAULT
     # (data, model) serving submesh — see ServingMeshConfig; shape
     # constraints the model config imposes (model | kv_heads,
     # data | max_batch_slots) are checked at ServingEngine build, where
@@ -133,6 +140,10 @@ class ServingConfig(ConfigModel):
             raise ValueError(
                 f"serving.no_progress_steps must be >= 0 (0 = disabled), "
                 f"got {self.no_progress_steps}")
+        if self.spec_k < 1:
+            raise ValueError(
+                f"serving.spec_k must be >= 1 (only read when a draft "
+                f"model is armed), got {self.spec_k}")
         if self.default_deadline_s < 0:
             raise ValueError(
                 f"serving.default_deadline_s must be >= 0 (0 = none), "

@@ -364,27 +364,12 @@ class InferenceEngine:
     @staticmethod
     def _sample(logits, rng, temperature, top_k, top_p):
         """fp32 categorical sampling with optional top-k / nucleus filter;
-        temperature 0 → greedy."""
-        logits = logits.astype(jnp.float32)
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1)
-        logits = logits / temperature
-        if top_k:
-            # O(V·k) top_k, not a full O(V log V) sort — this runs once per
-            # decoded token over the whole vocab
-            kth = jax.lax.top_k(logits, top_k)[0][..., -1][..., None]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
-        if top_p < 1.0:
-            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-            probs = jax.nn.softmax(sorted_logits, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            # smallest set with cumulative prob >= top_p (keep the first
-            # token crossing the threshold)
-            cutoff_idx = jnp.sum((cum < top_p).astype(jnp.int32), axis=-1)
-            cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[..., None],
-                                         axis=-1)
-            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-        return jax.random.categorical(rng, logits, axis=-1)
+        temperature 0 → greedy.  Delegates to the shared
+        :mod:`~.sampling` module — generate() and the serving engine
+        draw tokens through ONE implementation, which is what makes the
+        seeded generate ↔ serving parity hold."""
+        from .sampling import sample_tokens
+        return sample_tokens(logits, rng, temperature, top_k, top_p)
 
     def _build_generate(self, batch: int, prompt_len: int, max_new: int,
                         temperature: float, top_k: int, top_p: float,
@@ -420,8 +405,12 @@ class InferenceEngine:
             cache = {**cache, "index": true_len}
             last = jax.lax.dynamic_slice_in_dim(
                 logits, true_len - 1, 1, axis=1)[:, 0]
-            rng, sub = jax.random.split(rng)
-            tok = self._sample(last, sub, temperature, top_k, top_p)
+            # fold_in key schedule (inference/sampling.py): output token
+            # j draws with fold_in(rng, j) — the same schedule the
+            # serving engine uses per request, so a seeded generate()
+            # and a seeded serving stream are token-identical
+            tok = self._sample(last, jax.random.fold_in(rng, 0),
+                               temperature, top_k, top_p)
             done = (jnp.zeros((batch,), jnp.bool_) if eos_token_id is None
                     else tok == eos_token_id)
             return cache, tok, rng, done
@@ -429,19 +418,20 @@ class InferenceEngine:
         def decode(params, scales, cache, tok, rng, done):
             params = self._model_params(params, scales)
 
-            def step(carry, _):
+            def step(carry, j):
                 cache, tok, rng, done = carry
                 logits, cache = model.apply(params, tok[:, None], cache=cache)
-                rng, sub = jax.random.split(rng)
-                nxt = self._sample(logits[:, -1], sub, temperature, top_k,
-                                   top_p)
+                # output index j's token: fold_in(rng, j), matching the
+                # serving engine's per-request key schedule
+                nxt = self._sample(logits[:, -1], jax.random.fold_in(rng, j),
+                                   temperature, top_k, top_p)
                 if eos_token_id is not None:
                     nxt = jnp.where(done, eos_token_id, nxt)
                     done = done | (nxt == eos_token_id)
                 return (cache, nxt, rng, done), tok
 
             (_, last, _, _), toks = jax.lax.scan(
-                step, (cache, tok, rng, done), None, length=max_new - 1)
+                step, (cache, tok, rng, done), jnp.arange(1, max_new))
             return jnp.concatenate(
                 [toks.swapaxes(0, 1), last[:, None]], axis=1)
 
@@ -541,15 +531,32 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     # continuous-batching serving (inference/serving/, docs/serving.md)
     # ------------------------------------------------------------------
-    def serving_engine(self, rng: Optional[jax.Array] = None):
+    def serving_engine(self, rng: Optional[jax.Array] = None,
+                       draft_model=None, draft_params=None):
         """The continuous-batching front end over this engine's weights:
         paged KV pool, iteration-level scheduler, single-trace batched
-        decode.  Gated on the ``serving`` config block."""
+        decode with in-program per-request sampling.  Gated on the
+        ``serving`` config block.
+
+        ``rng`` seeds the engine's base sampling key (requests without
+        their own ``seed`` derive from it).  ``draft_model`` (a smaller
+        model sharing the target's vocab) arms speculative decoding:
+        the draft proposes ``serving.spec_k`` tokens per slot per
+        iteration and the target verifies them in the same single
+        compiled step — token-exact vs plain decode under the same
+        key (docs/serving.md "Speculative decoding")."""
         if not self.config.serving.enabled:
             raise ValueError(
                 "continuous-batching serving is disabled — set "
                 '{"serving": {"enabled": true}} in the inference config')
         if self._serving is None:
             from .serving import ServingEngine
-            self._serving = ServingEngine(self, rng=rng)
+            self._serving = ServingEngine(self, rng=rng,
+                                          draft_model=draft_model,
+                                          draft_params=draft_params)
+        elif draft_model is not None \
+                and self._serving._draft_model is not draft_model:
+            raise ValueError(
+                "serving engine already built without this draft model "
+                "— pass draft_model on the FIRST serving_engine() call")
         return self._serving

@@ -1,0 +1,116 @@
+"""Shared token sampling: ONE semantics for generate() and serving.
+
+Both the sequential :meth:`InferenceEngine.generate` loop and the
+serving engine's single compiled mixed step draw tokens through this
+module, so a request streamed through the continuous-batching front end
+is token-identical to the same prompt pushed through ``generate()``
+under the same PRNG key (the seeded-parity test pins it).
+
+Two call shapes over the same math:
+
+  * :func:`sample_tokens` — static Python scalars for temperature /
+    top-k / top-p (the generate() path).  Filters compile away when
+    neutral, and ``temperature == 0`` is a plain argmax.
+  * :func:`sample_tokens_per_row` — PER-ROW traced arrays (the serving
+    path): every decode slot carries its own temperature/top-k/top-p/
+    key as step *inputs*, so one compiled program serves any mix of
+    sampling configs without retracing (``decode_builds == 1``).
+
+The two paths are bit-identical for the same logits + key: the dynamic
+path's neutral filters (``top_k == 0`` → keep all, ``top_p >= 1`` →
+keep all) mask nothing and leave the logits bytes untouched, and both
+paths feed the identical filtered array to the identical categorical
+draw.
+
+Key schedule (`fold_in`, not a split chain): the token at OUTPUT index
+``j`` of a request is always sampled with ``fold_in(request_key, j)``.
+The key depends only on (request key, position) — never on batch
+composition, scheduling order, preemption count, or whether the token
+was proposed speculatively — which is what makes serving streams
+reproducible across mesh shapes and makes the speculative verify lane
+token-exact against the non-speculative sampler.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fold_in_keys(keys: jax.Array, indices: jax.Array) -> jax.Array:
+    """Per-row ``fold_in``: ``keys`` [..., 2] uint32 raw key data,
+    ``indices`` [...] int32 → folded raw key data, same shape."""
+    flat_k = keys.reshape(-1, 2)
+    flat_i = indices.reshape(-1)
+    out = jax.vmap(jax.random.fold_in)(flat_k, flat_i)
+    return out.reshape(keys.shape)
+
+
+def sample_tokens(logits, key, temperature, top_k, top_p):
+    """fp32 categorical sampling over ``logits [..., V]`` with ONE key
+    and static (Python-scalar) sampling params; temperature 0 = greedy
+    argmax.  Neutral filters (top_k 0, top_p >= 1) are skipped at trace
+    time."""
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k:
+        # O(V·k) top_k, not a full O(V log V) sort — this runs once per
+        # decoded token over the whole vocab
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set with cumulative prob >= top_p (keep the first
+        # token crossing the threshold)
+        cutoff_idx = jnp.sum((cum < top_p).astype(jnp.int32), axis=-1)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[..., None],
+                                     axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def sample_tokens_per_row(logits, keys, temperature, top_k, top_p):
+    """Per-row sampling for the serving step: ``logits [B, V]`` with
+    PER-ROW traced params — ``keys [B, 2]`` uint32, ``temperature [B]``
+    f32, ``top_k [B]`` int32 (0 = off), ``top_p [B]`` f32 (>= 1 = off).
+    Rows with ``temperature == 0`` take the greedy argmax of the raw
+    logits (bit-exact vs the static path).
+
+    Everything is data, nothing is shape: one trace covers every
+    per-slot sampling mix (the ``decode_builds == 1`` contract).  The
+    top-k threshold comes from a sort + rank compare instead of
+    ``lax.top_k`` (whose k must be static); the selected threshold
+    VALUE is identical, so the masked array matches the static path
+    byte-for-byte."""
+    v = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1)
+    t = jnp.asarray(temperature, jnp.float32)
+    scaled = logits / jnp.maximum(t, 1e-8)[..., None]
+    # -- top-k: k-th largest value as the keep threshold (k = V keeps
+    # everything and leaves the bytes untouched) --
+    k = jnp.asarray(top_k, jnp.int32)
+    k_eff = jnp.where(k > 0, jnp.clip(k, 1, v), v)
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[..., None], axis=-1)
+    filt = jnp.where(scaled < kth, -jnp.inf, scaled)
+    # -- top-p (nucleus) over the top-k-filtered logits, matching the
+    # static path's filter order; p >= 1 pins the cutoff to the minimum
+    # so nothing masks (cumsum rounding must not shave the tail) --
+    p = jnp.asarray(top_p, jnp.float32)
+    s2 = jnp.sort(filt, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(s2, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.sum((cum < p[..., None]).astype(jnp.int32), axis=-1)
+    cutoff_idx = jnp.where(p >= 1.0, v - 1, cutoff_idx)
+    cutoff = jnp.take_along_axis(s2, cutoff_idx[..., None], axis=-1)
+    filt = jnp.where(filt < cutoff, -jnp.inf, filt)
+
+    def draw(kk, row):
+        return jax.random.categorical(kk, row)
+    sampled = jax.vmap(draw)(keys.reshape(-1, 2),
+                             filt.reshape(-1, v)).reshape(greedy.shape)
+    return jnp.where(t <= 0.0, greedy, sampled).astype(jnp.int32)

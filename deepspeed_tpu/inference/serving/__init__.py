@@ -8,17 +8,26 @@ Three layers, composed by ``InferenceEngine.serving_engine()``:
     admission, LIFO recompute preemption, completion draining;
   * :mod:`engine` — the compiled prefill / single-trace decode programs
     over ``ops/transformer/paged_decode_attention.py``, instrumented
-    with the ``dstpu_serving_*`` observability metrics.
+    with the ``dstpu_serving_*`` observability metrics — now with
+    in-program per-request sampling, token streaming, and an optional
+    speculative-decoding draft lane;
+  * :mod:`frontend` — the SLO-grade multi-tenant front-end
+    (:class:`ServingFrontend`): weighted-fair admission / prefill /
+    shed policies plus per-tenant metrics.
 """
 from ...runtime.resilience.errors import ServingError  # noqa: F401
 from .block_allocator import (BlockPoolError, NULL_BLOCK,  # noqa: F401
                               PagedBlockAllocator, blocks_for_budget,
                               kv_block_bytes)
 from .engine import ServingEngine  # noqa: F401
+from .frontend import (ServingFrontend, StreamCollector,  # noqa: F401
+                       TokenEvent, TenantRegistry, TenantSpec)
 from .scheduler import (ContinuousBatchingScheduler, Request,  # noqa: F401
                         RequestState, RequestStatus)
 
 __all__ = ["BlockPoolError", "NULL_BLOCK", "PagedBlockAllocator",
            "ContinuousBatchingScheduler", "Request", "RequestState",
            "RequestStatus", "ServingEngine", "ServingError",
+           "ServingFrontend", "StreamCollector", "TokenEvent",
+           "TenantRegistry", "TenantSpec",
            "kv_block_bytes", "blocks_for_budget"]

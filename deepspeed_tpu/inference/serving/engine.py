@@ -43,7 +43,7 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -57,9 +57,11 @@ from ...runtime.resilience.errors import (FatalIOError, ServingError,
                                           TransientIOError)
 from ...runtime.resilience.fault_injection import get_fault_injector
 from ...utils.logging import logger
+from ..sampling import fold_in_keys, sample_tokens_per_row
 from .block_allocator import PagedBlockAllocator
+from .frontend.streaming import TokenEvent
 from .scheduler import (ContinuousBatchingScheduler, Request,
-                        RequestStatus)
+                        RequestState, RequestStatus)
 
 
 def _tp_qkv_perm(nh: int, nkv: int, hd: int, mp: int) -> np.ndarray:
@@ -98,15 +100,24 @@ class ServingEngine:
         srv.run()                      # drain
         streams = [r.output for r in reqs]
 
-    Sampling uses the inference config's ``temperature``/``top_k``/
-    ``top_p`` (temperature 0 = greedy).  Greedy streams are identical
-    to per-request ``generate()`` — the integration test pins it, with
-    prefix caching and chunked prefill both on; stochastic sampling
-    draws from the serving engine's own rng stream, so it matches
-    ``generate`` in distribution, not token-for-token.
+    Sampling is PER REQUEST and IN PROGRAM: ``submit()`` takes
+    ``temperature``/``top_k``/``top_p``/``seed`` (defaulting to the
+    inference config), and every slot's params + PRNG key ride the ONE
+    compiled mixed step as data — any mix of sampling configs shares
+    the program (``decode_builds == 1``).  Output token j of a request
+    is always drawn with ``fold_in(request_key, j)``, so a stream is
+    reproducible across batch composition, admission order, preemption,
+    and mesh shape, and token-identical to ``generate()`` under the
+    same key (temperature 0 is bit-exact greedy).  ``submit(on_token=
+    ...)`` streams tokens at iteration boundaries (see
+    ``frontend/streaming.py``); a draft model passed at construction
+    arms the speculative third lane (docs/serving.md "Speculative
+    decoding") with exact token equivalence to the non-speculative
+    sampler.
     """
 
-    def __init__(self, engine, rng: Optional[jax.Array] = None):
+    def __init__(self, engine, rng: Optional[jax.Array] = None,
+                 draft_model=None, draft_params=None):
         cfg = engine.config.serving
         model = engine.module
         reason = model._paged_supported()
@@ -183,13 +194,34 @@ class ServingEngine:
         self.temperature = engine.config.temperature
         self.top_k = engine.config.top_k
         self.top_p = engine.config.top_p
-        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        #: raw uint32 base key: a submit() without an explicit seed
+        #: samples with this key — the same default ``generate()``
+        #: uses, so unseeded serving matches unseeded generate
+        base = rng if rng is not None else jax.random.PRNGKey(0)
+        self._base_key = tuple(int(x) for x in np.asarray(base))
+
+        #: draft-model speculative decoding (Leviathan et al., ICML
+        #: '23): ``serving.spec_k`` proposals per slot per iteration,
+        #: verified by the target in the mixed step's third lane
+        self.spec_k = cfg.spec_k
+        self._draft_model = draft_model
+        self._draft_params = draft_params
+        self._tp_draft = None
+        self._dpool_k = self._dpool_v = None
+        if draft_model is not None:
+            self._init_draft(draft_model, draft_params)
 
         #: incremented at TRACE time inside the mixed program — the
         #: "the serving loop compiles exactly one program, whatever the
         #: prompt-length distribution" acceptance pin
         self.decode_builds = 0
         self._step_fn = None
+        # -- streaming (frontend/streaming.py): token/terminal events
+        # buffer inside an iteration and flush at its boundary; engine-
+        # level hooks are the frontend's fairness + metrics taps -------
+        self.token_hooks: List[Callable] = []
+        self.lifecycle_hooks: List[Callable] = []
+        self._event_buf: List[TokenEvent] = []
         # donation keeps the pools in-place on TPU; the CPU backend
         # does not implement donation and would warn every dispatch
         self._donate = jax.default_backend() == "tpu"
@@ -282,6 +314,22 @@ class ServingEngine:
         #: callers without the metrics registry
         self.lifecycle_counts = {"cancelled": 0, "timed_out": 0,
                                  "shed": 0, "failed": 0, "quarantined": 0}
+        # speculative-decoding acceptance (docs/serving.md "Speculative
+        # decoding"): rate = accepted / proposed
+        self._m_spec_proposed = reg.counter(
+            "dstpu_serving_spec_proposed_tokens_total",
+            "draft tokens proposed to the speculative verify lane")
+        self._m_spec_accepted = reg.counter(
+            "dstpu_serving_spec_accepted_tokens_total",
+            "draft tokens accepted by the target's verify step")
+        reg.gauge(
+            "dstpu_serving_spec_k",
+            "draft proposals per slot per iteration (0 = speculative "
+            "decoding off)").set(self.spec_k if draft_model is not None
+                                 else 0)
+        #: plain-int mirror for bench_all (acceptance_rate =
+        #: accepted / proposed)
+        self.spec_counts = {"proposed": 0, "accepted": 0}
         # counter deltas are polled off the (jax-free) allocator's
         # cumulative ints
         self._hits_polled = 0
@@ -414,18 +462,137 @@ class ServingEngine:
         return total // self.tp_model_size
 
     # ------------------------------------------------------------------
+    # speculative decoding (draft lane)
+    # ------------------------------------------------------------------
+    def _init_draft(self, draft, params) -> None:
+        """Validate the draft model against the target and build its
+        OWN paged pools (same geometry, same block tables/lens as the
+        target's — the draft pool moves in lockstep, so preemption,
+        prefix hits, and slot churn all stay valid for speculation).
+        The draft pool is never quantized: it is small by construction
+        and its logits drive acceptance, not output."""
+        cfg = self.engine.config.serving
+        reason = draft._paged_supported()
+        if reason is not None:
+            raise NotImplementedError(
+                f"speculative draft model cannot run the paged path: "
+                f"{reason}")
+        if draft.config.vocab_size != self.model.config.vocab_size:
+            raise ValueError(
+                f"draft vocab_size ({draft.config.vocab_size}) must "
+                f"match the target's ({self.model.config.vocab_size}) "
+                f"— proposals are token ids")
+        if draft.config.max_seq_len < self.engine.config.max_out_tokens:
+            raise ValueError(
+                f"draft max_seq_len ({draft.config.max_seq_len}) is "
+                f"shorter than max_out_tokens "
+                f"({self.engine.config.max_out_tokens}) — the draft "
+                f"must reach every position the target serves")
+        if params is None:
+            # fresh-init drafts are only useful for plumbing tests:
+            # acceptance will be ~chance.  Real deployments pass a
+            # trained (typically distilled) draft checkpoint.
+            logger.warning(
+                "serving: no draft_params given — initializing an "
+                "UNTRAINED draft (near-zero acceptance; pass a trained "
+                "draft checkpoint for real speedups)")
+            params = draft.init(jax.random.PRNGKey(1))
+        self._draft_params = params
+        with trace_span("serving/draft_pool", blocks=cfg.num_kv_blocks):
+            dpools = draft.init_paged_cache(
+                cfg.num_kv_blocks, self.block_size,
+                dtype=self.engine.dtype, kv_bits=0)
+        self._dpool_k, self._dpool_v = dpools["k"], dpools["v"]
+        self._tp_draft = draft
+        if self._tp:
+            # the draft replicates over BOTH mesh axes (it is small);
+            # its view arms only the data axis so the slot-sharded
+            # lens/tables it shares with the target stay correct
+            self._tp_draft = draft.tp_serving_view(
+                1, None,
+                topo.DATA_AXIS if self.tp_data_size > 1 else None)
+            rep = NamedSharding(self.tp_mesh, P())
+            self._dpool_k = jax.device_put(self._dpool_k, rep)
+            self._dpool_v = jax.device_put(self._dpool_v, rep)
+            self._draft_params = jax.device_put(self._draft_params, rep)
+        logger.info(
+            f"serving: speculative decoding armed — draft "
+            f"{draft.config.num_layers}L/{draft.config.d_model}d, "
+            f"k={self.spec_k} proposals/slot/iteration")
+
+    # ------------------------------------------------------------------
+    # token streaming (frontend/streaming.py)
+    # ------------------------------------------------------------------
+    def _emit_token(self, req: Request, token: int) -> None:
+        """Buffer one emitted token (status/final resolved at flush —
+        the request may reach a terminal state later in the same
+        iteration)."""
+        now = time.perf_counter()
+        self._event_buf.append(TokenEvent(
+            request=req, token=token, index=len(req.output) - 1,
+            status=None, final=False, tenant=req.tenant, time_s=now,
+            prev_time_s=req.last_token_time))
+        req.last_token_time = now
+
+    def _flush_events(self) -> None:
+        """Deliver buffered token/terminal events at the iteration
+        boundary: engine-level hooks first (frontend fairness +
+        metrics), then the request's own ``on_token``.  A callback
+        exception disables that request's stream — logged once, the
+        request and the batch keep running."""
+        if not self._event_buf:
+            return
+        events, self._event_buf = self._event_buf, []
+        last_of = {id(ev.request): i for i, ev in enumerate(events)}
+        for i, ev in enumerate(events):
+            req = ev.request
+            if req.state is RequestState.FINISHED and \
+                    last_of[id(req)] == i:
+                ev = ev._replace(status=req.status, final=True)
+            for hook in self.token_hooks:
+                try:
+                    hook(ev)
+                except Exception as e:     # hook bugs must not stall serving
+                    logger.warning(f"serving: token hook failed: {e!r}")
+            cb = req.on_token
+            if cb is None:
+                continue
+            try:
+                cb(ev)
+            except Exception as e:
+                req.on_token = None
+                logger.warning(
+                    f"serving: {req.req_id} on_token callback raised "
+                    f"{e!r} — stream disabled, request continues")
+
+    # ------------------------------------------------------------------
     # request intake
     # ------------------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                eos_token_id: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               temperature: Optional[float] = None,
+               top_k: Optional[int] = None,
+               top_p: Optional[float] = None,
+               seed: Optional[int] = None,
+               on_token: Optional[Callable] = None,
+               tenant: str = "default") -> Request:
         """Queue a request.  ``deadline_s`` is a TTL from submit, swept
         every ``step()`` whether the request is still WAITING or already
         RUNNING (defaults to ``serving.default_deadline_s``; 0 = none).
         Under overload (``serving.max_queue_depth`` waiting requests)
         the request is returned TERMINAL with ``status ==
         RequestStatus.SHED`` and an empty stream — check ``req.status``,
-        this is backpressure, not an exception."""
+        this is backpressure, not an exception.
+
+        ``temperature``/``top_k``/``top_p`` default to the inference
+        config; ``seed`` derives the request's PRNG key (None = the
+        engine's base key, matching an unseeded ``generate()``) —
+        output token j is always sampled with ``fold_in(key, j)``, so
+        the stream is reproducible regardless of batching.
+        ``on_token`` receives a :class:`TokenEvent` per emitted token
+        at iteration boundaries.  ``tenant`` tags the request for the
+        multi-tenant frontend's fairness accounting."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         total = len(prompt) + max_new_tokens
         if total > self.engine.config.max_out_tokens:
@@ -438,12 +605,29 @@ class ServingEngine:
                 f"{deadline_s}")
         if deadline_s is None:
             deadline_s = self.default_deadline_s
+        temperature = (self.temperature if temperature is None
+                       else float(temperature))
+        top_k = self.top_k if top_k is None else int(top_k)
+        top_p = self.top_p if top_p is None else float(top_p)
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got "
+                             f"{temperature}")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0 (0 = off), got "
+                             f"{top_k}")
+        if not 0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        key = (self._base_key if seed is None else tuple(
+            int(x) for x in np.asarray(jax.random.PRNGKey(seed))))
         req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
                       eos_token_id=eos_token_id,
-                      deadline_s=deadline_s if deadline_s else None)
+                      deadline_s=deadline_s if deadline_s else None,
+                      temperature=temperature, top_k=top_k, top_p=top_p,
+                      prng_key=key, on_token=on_token, tenant=tenant)
         self.scheduler.submit(req)
         self._drain_terminal_events()
         self._m_queue.set(self.scheduler.queue_depth)
+        self._flush_events()
         return req
 
     def cancel(self, req: Request) -> bool:
@@ -458,6 +642,7 @@ class ServingEngine:
             ok = self.scheduler.cancel(req)
         self._drain_terminal_events()
         self._update_gauges()
+        self._flush_events()
         return ok
 
     def _drain_terminal_events(self) -> int:
@@ -480,6 +665,19 @@ class ServingEngine:
             self.lifecycle_counts[key] += 1
             logger.warning(f"serving: {req.req_id} -> {req.status.value}"
                            f"{': ' + req.error if req.error else ''}")
+            # the stream must END even when no token ever flowed: a
+            # tokenless terminal event closes it with the status
+            self._event_buf.append(TokenEvent(
+                request=req, token=None, index=len(req.output),
+                status=req.status, final=True, tenant=req.tenant,
+                time_s=time.perf_counter(),
+                prev_time_s=req.last_token_time))
+            for hook in self.lifecycle_hooks:
+                try:
+                    hook(req)
+                except Exception as e:
+                    logger.warning(
+                        f"serving: lifecycle hook failed: {e!r}")
         return len(events)
 
     # ------------------------------------------------------------------
@@ -490,10 +688,27 @@ class ServingEngine:
         # plain model; its per-shard head counts + armed axis names are
         # what make the SAME body below shard-correct inside shard_map
         engine, model = self.engine, self._tp_model
+        draft = self._tp_draft
+        spec_on = self._draft_model is not None
+        S = self.spec_k + 1 if spec_on else 0
+
+        def sample_first(chunk_logits, c_temp, c_top_k, c_top_p, c_key,
+                         c_out_idx):
+            # the chunk's first token: output index c_out_idx of the
+            # prefilling request, drawn with ITS key — identical to the
+            # token a decode iteration would have produced, which is
+            # what makes preempt-recompute and prefix-hit resumes
+            # token-exact
+            return sample_tokens_per_row(
+                chunk_logits[None],
+                fold_in_keys(c_key[None], c_out_idx[None]),
+                c_temp[None], c_top_k[None], c_top_p[None])[0]
 
         def step(params, scales, pool_k, pool_v, pool_ks, pool_vs,
                  tables, lens, dec_tokens, dec_active, chunk_ids,
-                 chunk_slot, chunk_start, chunk_len, rng):
+                 chunk_slot, chunk_start, chunk_len,
+                 temp, top_k, top_p, keys, out_idx,
+                 c_temp, c_top_k, c_top_p, c_key, c_out_idx):
             # trace-time side effect: counts program BUILDS, not calls —
             # continuous batching must never retrace this
             self.decode_builds += 1
@@ -504,12 +719,15 @@ class ServingEngine:
             dec_logits, chunk_logits, cache = model._apply_paged_mixed(
                 mp, cache, dec_tokens, dec_active, chunk_ids, chunk_slot,
                 chunk_start, chunk_len)
-            rng, s_dec, s_first = jax.random.split(rng, 3)
-            nxt = engine._sample(dec_logits, s_dec, self.temperature,
-                                 self.top_k, self.top_p)
-            first = engine._sample(chunk_logits[None], s_first,
-                                   self.temperature, self.top_k,
-                                   self.top_p)[0]
+            # in-program per-slot sampling: output token j of a request
+            # is ALWAYS drawn with fold_in(request_key, j) — batch-,
+            # order- and preemption-independent (docs/serving.md
+            # "Sampling, streaming & multi-tenant SLOs")
+            nxt = sample_tokens_per_row(
+                dec_logits, fold_in_keys(keys, out_idx), temp, top_k,
+                top_p)
+            first = sample_first(chunk_logits, c_temp, c_top_k, c_top_p,
+                                 c_key, c_out_idx)
             # per-slot finite flags, computed IN-PROGRAM (no extra
             # dispatch, no retrace — decode_builds stays 1): a slot
             # whose logits go non-finite is quarantined host-side
@@ -519,33 +737,133 @@ class ServingEngine:
             chunk_finite = jnp.all(jnp.isfinite(chunk_logits))
             return (nxt.astype(jnp.int32), first.astype(jnp.int32),
                     dec_finite, chunk_finite, cache["k"], cache["v"],
-                    cache.get("k_scale"), cache.get("v_scale"), rng)
+                    cache.get("k_scale"), cache.get("v_scale"))
+
+        def spec_step(params, scales, dparams, pool_k, pool_v, pool_ks,
+                      pool_vs, dpool_k, dpool_v, tables, lens,
+                      dec_tokens, dec_active, spec_active, chunk_ids,
+                      chunk_slot, chunk_start, chunk_len,
+                      temp, top_k, top_p, keys, out_idx,
+                      c_temp, c_top_k, c_top_p, c_key, c_out_idx):
+            self.decode_builds += 1
+            mp = engine._model_params(params, scales)
+            empty = jnp.zeros((0,), jnp.int32)
+            zero = jnp.asarray(0, jnp.int32)
+            zeros_b = jnp.zeros_like(dec_tokens)
+            # --- draft lane (Leviathan et al.): spec_k proposals per
+            # speculating slot + one KV-only step, inside the ONE
+            # program.  The draft pool moves in LOCKSTEP with the
+            # target pool: feed 0 also writes every PLAIN-decoding
+            # slot's token, and the chunk mirror replays the prefill
+            # chunk — so every committed / prefix-cached block is valid
+            # in BOTH pools and speculation survives preemption, prefix
+            # hits, and slot churn.
+            dcache = {"k": dpool_k, "v": dpool_v,
+                      "block_tables": tables, "lens": lens}
+            _dl, _cl, dcache = draft._apply_paged_mixed(
+                dparams, dcache, zeros_b, zeros_b, chunk_ids,
+                chunk_slot, chunk_start, chunk_len)
+            any_active = ((dec_active > 0)
+                          | (spec_active > 0)).astype(jnp.int32)
+            cur = dec_tokens
+            toks = [cur]
+            for i in range(S):     # feeds: x, d_1 .. d_{k-1}, then d_k
+                dcache = dict(dcache, lens=lens + i)
+                dlg, _cl, dcache = draft._apply_paged_mixed(
+                    dparams, dcache, cur,
+                    any_active if i == 0 else spec_active,
+                    empty, zero, zero, zero)
+                if i < S - 1:
+                    # the draft draws with the SAME deterministic key
+                    # the target uses at that position: when the
+                    # distributions agree so do the samples, and the
+                    # exact-match verify below accepts
+                    cur = sample_tokens_per_row(
+                        dlg, fold_in_keys(keys, out_idx + i), temp,
+                        top_k, top_p)
+                    toks.append(cur)
+            spec_tokens = jnp.stack(toks, axis=1)            # [B, S]
+            cache = {"k": pool_k, "v": pool_v, "k_scale": pool_ks,
+                     "v_scale": pool_vs, "block_tables": tables,
+                     "lens": lens}
+            dec_logits, spec_logits, chunk_logits, cache = \
+                model._apply_paged_mixed(
+                    mp, cache, dec_tokens, dec_active, chunk_ids,
+                    chunk_slot, chunk_start, chunk_len,
+                    spec_tokens=spec_tokens, spec_active=spec_active)
+            nxt = sample_tokens_per_row(
+                dec_logits, fold_in_keys(keys, out_idx), temp, top_k,
+                top_p)
+            # the target samples s_i at every draft position with that
+            # position's own key; accept d_i while d_i == s_{i-1}.
+            # Every accepted position therefore saw EXACTLY the context
+            # and key the sequential sampler would have — token
+            # equivalence by construction, not merely in distribution —
+            # and the EMITTED tokens are always the target's samples.
+            s = jnp.stack(
+                [sample_tokens_per_row(
+                    spec_logits[:, i], fold_in_keys(keys, out_idx + i),
+                    temp, top_k, top_p) for i in range(S)], axis=1)
+            matches = (spec_tokens[:, 1:] == s[:, :-1]).astype(jnp.int32)
+            n_emit = 1 + jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
+            first = sample_first(chunk_logits, c_temp, c_top_k, c_top_p,
+                                 c_key, c_out_idx)
+            dec_finite = jnp.all(jnp.isfinite(dec_logits), axis=-1)
+            spec_finite = jnp.all(jnp.isfinite(spec_logits),
+                                  axis=(-2, -1))
+            chunk_finite = jnp.all(jnp.isfinite(chunk_logits))
+            return (nxt.astype(jnp.int32), first.astype(jnp.int32),
+                    s.astype(jnp.int32), n_emit.astype(jnp.int32),
+                    dec_finite, spec_finite, chunk_finite,
+                    cache["k"], cache["v"], cache.get("k_scale"),
+                    cache.get("v_scale"), dcache["k"], dcache["v"])
 
         get_registry().counter("dstpu_jit_programs_built_total").inc()
         # the quantized pool's scale planes are donated with it (they
-        # are rewritten at every scatter, exactly like the values)
-        donate = (2, 3, 4, 5) if self.kv_bits else (2, 3)
+        # are rewritten at every scatter, exactly like the values); the
+        # draft pools donate alongside the target's
+        if spec_on:
+            fn = spec_step
+            donate = (3, 4, 7, 8) + ((5, 6) if self.kv_bits else ())
+        else:
+            fn = step
+            donate = (2, 3) + ((4, 5) if self.kv_bits else ())
         if not self._tp:
             with self.engine.mesh:
                 return jax.jit(
-                    step, donate_argnums=donate if self._donate else ())
+                    fn, donate_argnums=donate if self._donate else ())
         # TP: the same body, shard_mapped over the (data, model) serving
         # submesh.  Pools/params shard over 'model' (kv_head axis /
-        # column-row tiles), slot-shaped inputs over 'data'; the chunk,
-        # rng and scalars stay replicated so every shard traces the one
-        # identical program (decode_builds == 1 regardless of mesh)
+        # column-row tiles); slot-shaped inputs — including the per-slot
+        # sampling params, keys, and output indices — over 'data'; the
+        # chunk and its sampling scalars stay replicated, and the draft
+        # (params + pools) replicates over both axes, so every shard
+        # traces the one identical program (decode_builds == 1
+        # regardless of mesh)
         d, m = topo.DATA_AXIS, topo.MODEL_AXIS
         pool_sp = self._pool_spec
         pscale_sp = self._pscale_spec if self.kv_bits else P()
         scale_sp = (self._tp_scale_specs
                     if self._tp_scales is not None else P())
-        in_specs = (self._tp_param_specs, scale_sp,
-                    pool_sp, pool_sp, pscale_sp, pscale_sp,
-                    P(d, None), P(d), P(d), P(d),
-                    P(), P(), P(), P(), P())
-        out_specs = (P(d), P(), P(d), P(),
-                     pool_sp, pool_sp, pscale_sp, pscale_sp, P())
-        sharded = shard_map(step, mesh=self.tp_mesh, in_specs=in_specs,
+        samp_in = (P(d), P(d), P(d), P(d, None), P(d),
+                   P(), P(), P(), P(), P())
+        if spec_on:
+            in_specs = (self._tp_param_specs, scale_sp, P(),
+                        pool_sp, pool_sp, pscale_sp, pscale_sp,
+                        P(), P(),
+                        P(d, None), P(d), P(d), P(d), P(d),
+                        P(), P(), P(), P()) + samp_in
+            out_specs = (P(d), P(), P(d, None), P(d), P(d), P(d), P(),
+                         pool_sp, pool_sp, pscale_sp, pscale_sp,
+                         P(), P())
+        else:
+            in_specs = (self._tp_param_specs, scale_sp,
+                        pool_sp, pool_sp, pscale_sp, pscale_sp,
+                        P(d, None), P(d), P(d), P(d),
+                        P(), P(), P(), P()) + samp_in
+            out_specs = (P(d), P(), P(d), P(),
+                         pool_sp, pool_sp, pscale_sp, pscale_sp)
+        sharded = shard_map(fn, mesh=self.tp_mesh, in_specs=in_specs,
                             out_specs=out_specs, axis_names={d, m})
         with self.tp_mesh:
             return jax.jit(
@@ -570,17 +888,19 @@ class ServingEngine:
         logger.error(f"serving: {req.req_id}: {msg}")
 
     def _dispatch(self, dec: List[Tuple[int, Request]],
-                  chunk: Optional[Tuple[int, Request, int, int]]
+                  chunk: Optional[Tuple[int, Request, int, int]],
+                  spec: List[Tuple[int, Request]] = ()
                   ) -> Optional[int]:
         """One dispatch of the mixed program: a decode token for every
-        slot in ``dec`` plus (optionally) one prompt chunk, then apply
-        the results to the scheduler's request records.  Returns the
-        progress made (decode tokens emitted + prefill tokens landed) —
-        the serving watchdog's heartbeat — or ``None`` when a transient
-        fault at the dispatch site skipped the dispatch: the caller
-        abandons the whole iteration (no budget charged, the same work
-        retries NEXT step; streams are delayed, never corrupted).  A
-        fatal fault raises :class:`ServingError`."""
+        slot in ``dec``, a draft+verify round for every slot in ``spec``
+        (draft armed only), plus (optionally) one prompt chunk, then
+        apply the results to the scheduler's request records.  Returns
+        the progress made (decode tokens emitted + prefill tokens
+        landed) — the serving watchdog's heartbeat — or ``None`` when a
+        transient fault at the dispatch site skipped the dispatch: the
+        caller abandons the whole iteration (no budget charged, the same
+        work retries NEXT step; streams are delayed, never corrupted).
+        A fatal fault raises :class:`ServingError`."""
         try:
             get_fault_injector().check("serving.dispatch")
         except TransientIOError as e:
@@ -591,23 +911,45 @@ class ServingEngine:
             raise ServingError(
                 f"fatal fault at serving dispatch: {e}") from e
         sched = self.scheduler
+        spec_on = self._draft_model is not None
         tables = np.zeros((self.num_slots, self.max_pages), np.int32)
         lens = np.zeros((self.num_slots,), np.int32)
         dec_tokens = np.zeros((self.num_slots,), np.int32)
         dec_active = np.zeros((self.num_slots,), np.int32)
+        spec_active = np.zeros((self.num_slots,), np.int32)
+        temp = np.zeros((self.num_slots,), np.float32)
+        top_k = np.zeros((self.num_slots,), np.int32)
+        top_p = np.ones((self.num_slots,), np.float32)
+        keys = np.zeros((self.num_slots, 2), np.uint32)
+        out_idx = np.zeros((self.num_slots,), np.int32)
         for slot, req in sched.running.items():
             table = self.allocator.block_table(req.req_id)
             tables[slot, :len(table)] = table
             lens[slot] = req.cached_tokens
-        for slot, req in dec:
-            dec_active[slot] = 1
+        for slot, req in list(dec) + list(spec):
             dec_tokens[slot] = req.output[-1]
+            temp[slot] = req.temperature
+            top_k[slot] = req.top_k
+            top_p[slot] = req.top_p
+            keys[slot] = req.prng_key
+            out_idx[slot] = len(req.output)
+        for slot, _req in dec:
+            dec_active[slot] = 1
+        for slot, _req in spec:
+            spec_active[slot] = 1
         chunk_ids = np.zeros((self.chunk_tokens,), np.int32)
         c_slot = c_start = c_len = 0
+        c_temp, c_top_k, c_top_p = 0.0, 0, 1.0
+        c_key = np.zeros((2,), np.uint32)
+        c_oidx = 0
         if chunk is not None:
             c_slot, req, c_start, c_len = chunk[0], chunk[1], chunk[2], \
                 chunk[3]
             chunk_ids[:c_len] = req.prefix[c_start:c_start + c_len]
+            c_temp, c_top_k, c_top_p = req.temperature, req.top_k, \
+                req.top_p
+            c_key = np.asarray(req.prng_key, np.uint32)
+            c_oidx = len(req.output)
         if self._step_fn is None:
             self._step_fn = self._build_step()
         t0 = time.perf_counter()
@@ -615,6 +957,10 @@ class ServingEngine:
             if dec:
                 spans.enter_context(
                     trace_span("serving/decode", batch=len(dec)))
+            if spec:
+                spans.enter_context(trace_span(
+                    "serving/spec_decode", batch=len(spec),
+                    k=self.spec_k))
             if chunk is not None:
                 spans.enter_context(
                     trace_span("serving/prefill_chunk", slot=c_slot,
@@ -630,15 +976,38 @@ class ServingEngine:
             else:
                 params = self.engine.params
                 scales = getattr(self.engine, "_scales", None)
-            (nxt, first, dec_fin, chunk_fin, self._pool_k, self._pool_v,
-             self._pool_ks, self._pool_vs, self._rng) = self._step_fn(
-                params, scales,
-                self._pool_k, self._pool_v, self._pool_ks,
-                self._pool_vs, tables, lens, dec_tokens,
-                dec_active, chunk_ids,
-                jnp.asarray(c_slot, jnp.int32),
-                jnp.asarray(c_start, jnp.int32),
-                jnp.asarray(c_len, jnp.int32), self._rng)
+            samp_args = (temp, top_k, top_p, keys, out_idx,
+                         jnp.asarray(c_temp, jnp.float32),
+                         jnp.asarray(c_top_k, jnp.int32),
+                         jnp.asarray(c_top_p, jnp.float32),
+                         c_key, jnp.asarray(c_oidx, jnp.int32))
+            if spec_on:
+                (nxt, first, emitted, n_emit, dec_fin, spec_fin,
+                 chunk_fin, self._pool_k, self._pool_v, self._pool_ks,
+                 self._pool_vs, self._dpool_k, self._dpool_v) = \
+                    self._step_fn(
+                        params, scales, self._draft_params,
+                        self._pool_k, self._pool_v, self._pool_ks,
+                        self._pool_vs, self._dpool_k, self._dpool_v,
+                        tables, lens, dec_tokens, dec_active,
+                        spec_active, chunk_ids,
+                        jnp.asarray(c_slot, jnp.int32),
+                        jnp.asarray(c_start, jnp.int32),
+                        jnp.asarray(c_len, jnp.int32), *samp_args)
+                emitted = np.asarray(emitted)
+                n_emit = np.asarray(n_emit)
+                spec_fin = np.asarray(spec_fin)
+            else:
+                (nxt, first, dec_fin, chunk_fin, self._pool_k,
+                 self._pool_v, self._pool_ks, self._pool_vs) = \
+                    self._step_fn(
+                        params, scales,
+                        self._pool_k, self._pool_v, self._pool_ks,
+                        self._pool_vs, tables, lens, dec_tokens,
+                        dec_active, chunk_ids,
+                        jnp.asarray(c_slot, jnp.int32),
+                        jnp.asarray(c_start, jnp.int32),
+                        jnp.asarray(c_len, jnp.int32), *samp_args)
             nxt = np.asarray(nxt)
             dec_fin = np.asarray(dec_fin)
         # ITL = dispatch wall time only, captured BEFORE the host-side
@@ -653,7 +1022,9 @@ class ServingEngine:
                 self._quarantine(slot, req, "decode")
                 continue
             req.cached_tokens += 1
-            req.output.append(int(nxt[slot]))
+            tok = int(nxt[slot])
+            req.output.append(tok)
+            self._emit_token(req, tok)
             progress += 1
             if req.cached_tokens % self.block_size == 0:
                 # a decode-filled block just completed: register it so a
@@ -662,7 +1033,38 @@ class ServingEngine:
                                              req.cached_tokens)
             if req.done:
                 sched.finish(slot)
-        if dec:
+        for slot, req in spec:
+            if not bool(spec_fin[slot]):
+                self._quarantine(slot, req, "spec decode")
+                continue
+            # the KV rollback is the length vector: positions past
+            # lens + appended were written by rejected draft rows but
+            # are never attended (and are rewritten before they can be)
+            take = min(int(n_emit[slot]),
+                       req.max_new_tokens - len(req.output))
+            appended = 0
+            for j in range(take):
+                tok = int(emitted[slot, j])
+                req.output.append(tok)
+                self._emit_token(req, tok)
+                appended += 1
+                if req.done:
+                    break
+            old = req.cached_tokens
+            req.cached_tokens += appended
+            progress += appended
+            self.spec_counts["proposed"] += self.spec_k
+            self._m_spec_proposed.inc(self.spec_k)
+            if appended > 1:
+                self.spec_counts["accepted"] += appended - 1
+                self._m_spec_accepted.inc(appended - 1)
+            if req.cached_tokens // self.block_size \
+                    > old // self.block_size:
+                self.allocator.commit_cached(req.req_id, req.prefix,
+                                             req.cached_tokens)
+            if req.done:
+                sched.finish(slot)
+        if dec or spec:
             self._m_itl.observe(dispatch_dt)
             if progress:
                 self._m_tokens.inc(progress)
@@ -678,8 +1080,12 @@ class ServingEngine:
                                              req.cached_tokens)
                 if req.cached_tokens >= req.prefill_target:
                     # the chunk that completed the prefix carries the
-                    # first token (sampled from its last valid position)
-                    req.output.append(int(first))
+                    # first token (sampled from its last valid position
+                    # with the request's own key at output index 0 —
+                    # identical to what a decode step would emit)
+                    tok = int(first)
+                    req.output.append(tok)
+                    self._emit_token(req, tok)
                     self._m_tokens.inc()
                     if req.first_token_time is None:
                         req.first_token_time = time.perf_counter()
@@ -724,9 +1130,32 @@ class ServingEngine:
         while True:
             chunk = sched.next_prefill_chunk(budget)
             dec = sched.decoding_slots() if include_decode else []
-            if not dec and chunk is None:
+            spec: List[Tuple[int, Request]] = []
+            if dec and self._draft_model is not None:
+                # speculate on every decoding slot that (a) still wants
+                # >= 2 tokens (one round must be able to beat plain
+                # decode), (b) fits spec_k + 1 more positions inside the
+                # sequence bound, and (c) can grow its block table to
+                # cover the draft rows WITHOUT preempting anyone
+                # (try_grow never preempts — under KV pressure slots
+                # just fall back to plain decode)
+                S = self.spec_k + 1
+                limit = min(self.engine.config.max_out_tokens,
+                            sched.max_tokens_per_seq())
+                kept = []
+                for slot, req in dec:
+                    if (req.max_new_tokens - len(req.output) >= 2
+                            and req.cached_tokens + S <= limit
+                            and sched.try_grow(slot, S)):
+                        spec.append((slot, req))
+                    elif req.state is RequestState.RUNNING:
+                        # try_grow can fail a request fatally; only
+                        # still-running slots keep their decode seat
+                        kept.append((slot, req))
+                dec = kept
+            if not dec and not spec and chunk is None:
                 break
-            dispatched = self._dispatch(dec, chunk)
+            dispatched = self._dispatch(dec, chunk, spec)
             if dispatched is None:
                 # transient dispatch fault: abandon the iteration — the
                 # chunk budget was NOT charged and the same decode/chunk
@@ -741,6 +1170,10 @@ class ServingEngine:
                 break
         self._drain_terminal_events()
         self._update_gauges()
+        # one flush per iteration boundary: every token emitted above
+        # and every terminal transition reaches its stream callbacks
+        # here, on the serving thread, in emission order
+        self._flush_events()
         # terminal transitions count as progress: a sweep that expires
         # requests, a quarantine, or a thrash-fail all MOVED state.
         # Preemptions deliberately do not — a preemption-only iteration

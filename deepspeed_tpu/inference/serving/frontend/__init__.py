@@ -1,0 +1,20 @@
+"""SLO-grade multi-tenant serving front-end (docs/serving.md
+"Sampling, streaming & multi-tenant SLOs").
+
+Three composable pieces over the serving engine:
+
+  * :mod:`streaming` — per-token :class:`TokenEvent` delivery at
+    iteration boundaries (in-program sampling means the token IS the
+    dispatch output; no host-side sampling pass);
+  * :mod:`tenancy` — tenant specs (weight / priority / SLO targets)
+    and their live virtual-token counters (Sheng et al., OSDI '24);
+  * :mod:`frontend` — :class:`ServingFrontend`, wiring the registry
+    into the scheduler's admission / prefill / shed policy hooks and
+    the per-tenant ``dstpu_serving_tenant_*`` metrics.
+"""
+from .frontend import ServingFrontend  # noqa: F401
+from .streaming import StreamCollector, TokenEvent  # noqa: F401
+from .tenancy import TenantRegistry, TenantSpec  # noqa: F401
+
+__all__ = ["ServingFrontend", "StreamCollector", "TokenEvent",
+           "TenantRegistry", "TenantSpec"]

@@ -72,7 +72,7 @@ import itertools
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from ...runtime.resilience.errors import FatalIOError, TransientIOError
 from ...runtime.resilience.fault_injection import get_fault_injector
@@ -129,6 +129,27 @@ class Request:
     submit_time: float = field(default_factory=time.perf_counter)
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
+    #: owning tenant (frontend multi-tenancy; "default" = untenanted —
+    #: every legacy submit path lands there)
+    tenant: str = "default"
+    #: per-request sampling params, RESOLVED at submit (engine defaults
+    #: already applied): temperature 0 = greedy, top_k 0 = off,
+    #: top_p >= 1 = off.  They ride the compiled step as data, so any
+    #: mix of configs shares the one program.
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    #: raw uint32 PRNG key pair; output token j is ALWAYS sampled with
+    #: ``fold_in(prng_key, j)`` — batch-, order- and preemption-
+    #: independent, which is what makes streams reproducible
+    prng_key: Tuple[int, int] = (0, 0)
+    #: streaming callback — receives a ``TokenEvent`` per emitted token
+    #: at iteration boundaries; an exception disables THIS stream (the
+    #: request keeps generating), never the batch
+    on_token: Optional[Callable] = None
+    #: wall time of the most recently streamed token (per-tenant
+    #: inter-token latency accounting)
+    last_token_time: Optional[float] = None
 
     @property
     def prefix(self) -> List[int]:
@@ -178,6 +199,21 @@ class ContinuousBatchingScheduler:
         #: no block — dispatching would scatter into the null block) and
         #: retry growth next step.  Cleared by ensure_decode_capacity.
         self._growth_held: set = set()
+        # -- frontend policy hooks (all None = the legacy deterministic
+        # FCFS / oldest-first / shed-the-incoming behavior; the
+        # multi-tenant frontend installs weighted-fair implementations,
+        # docs/serving.md "Multi-tenant SLOs") ------------------------
+        #: fn(waiting: Deque[Request]) -> None — reorder the waiting
+        #: queue IN PLACE before an admission pass
+        self.admission_policy: Optional[Callable] = None
+        #: fn(prefilling: List[(slot, Request)]) -> same, reordered —
+        #: which prefilling slot's chunk rides the next iteration
+        self.prefill_policy: Optional[Callable] = None
+        #: fn(incoming: Request, waiting: List[Request]) ->
+        #: Optional[Request] — under a full queue, pick a WAITING victim
+        #: to shed in the incoming request's place (None / the incoming
+        #: request itself = shed the incoming, the legacy behavior)
+        self.shed_policy: Optional[Callable] = None
 
     # -- introspection -----------------------------------------------------
     @property
@@ -228,6 +264,20 @@ class ContinuousBatchingScheduler:
                 f" — raise serving.num_kv_blocks / max_out_tokens")
         if self.max_queue_depth and \
                 len(self.waiting) >= self.max_queue_depth:
+            victim = None
+            if self.shed_policy is not None:
+                victim = self.shed_policy(req, list(self.waiting))
+            if victim is not None and victim is not req:
+                # fairness shed: a queue-hogging tenant's WAITING
+                # request yields its place to the incoming one (same
+                # bounded total, different victim)
+                self.cancel(victim, RequestStatus.SHED,
+                            f"shed by fairness policy to admit "
+                            f"{req.req_id} (queue at "
+                            f"serving.max_queue_depth "
+                            f"{self.max_queue_depth})")
+                self.waiting.append(req)
+                return req
             self._terminalize(
                 req, RequestStatus.SHED,
                 f"queue full: {len(self.waiting)} waiting >= "
@@ -313,7 +363,14 @@ class ContinuousBatchingScheduler:
         request's prefix-cache hits, so a resubmitted or shared-prefix
         request starts with ``cached_tokens`` already covering its hit
         blocks and prefills only the tail.  Returns
-        ``[(slot, request), ...]``."""
+        ``[(slot, request), ...]``.
+
+        With an ``admission_policy`` installed the waiting queue is
+        reordered (stably) before the pass — head-of-line semantics
+        within the chosen order are kept, so a policy decides WHO is at
+        the head, not whether admission blocks."""
+        if self.admission_policy is not None and len(self.waiting) > 1:
+            self.admission_policy(self.waiting)
         admitted: List[Tuple[int, Request]] = []
         while self.waiting and len(self.running) < self.num_slots:
             req = self.waiting[0]
@@ -363,15 +420,18 @@ class ContinuousBatchingScheduler:
     def next_prefill_chunk(self, budget: int
                            ) -> Optional[Tuple[int, Request, int, int]]:
         """The next prompt chunk to compute under the per-iteration
-        token ``budget``: oldest-admitted prefilling slot, at most
-        ``budget`` tokens of its remaining prefix.  Returns
+        token ``budget``: oldest-admitted prefilling slot (or the
+        ``prefill_policy``'s choice), at most ``budget`` tokens of its
+        remaining prefix.  Returns
         ``(slot, request, start_row, n_tokens)`` or None."""
         if budget < 1:
             return None
-        for slot in self._admit_order:
-            req = self.running.get(slot)
-            if req is None or not req.prefilling:
-                continue
+        prefilling = [(s, self.running[s]) for s in self._admit_order
+                      if self.running.get(s) is not None
+                      and self.running[s].prefilling]
+        if self.prefill_policy is not None and len(prefilling) > 1:
+            prefilling = self.prefill_policy(prefilling)
+        for slot, req in prefilling:
             n = min(budget, req.prefill_target - req.cached_tokens)
             return slot, req, req.cached_tokens, n
         return None
@@ -430,6 +490,37 @@ class ContinuousBatchingScheduler:
                     self._preempt(victim_slot, victim)
                     preempted.append(victim)
         return preempted
+
+    def try_grow(self, slot: int, extra_tokens: int) -> bool:
+        """Best-effort table growth for the SPECULATIVE lane: ensure
+        ``slot`` owns blocks for ``cached_tokens + extra_tokens``
+        positions.  Unlike :meth:`ensure_decode_capacity` this NEVER
+        preempts — speculation is an optimization, so on any pressure
+        (pool dry, per-seq cap, transient fault, growth hold) it
+        returns False and the slot simply decodes plain this iteration.
+        A fatal fault still fails the request (the one non-optional
+        edge)."""
+        req = self.running.get(slot)
+        if req is None or req.state is not RequestState.RUNNING or \
+                req.req_id in self._growth_held:
+            return False
+        need = self.alloc.blocks_for_tokens(req.cached_tokens
+                                            + extra_tokens)
+        if need > self.max_blocks_per_seq:
+            return False
+        while len(self.alloc.block_table(req.req_id)) < need:
+            try:
+                self.alloc.append_block(req.req_id)
+            except TransientIOError:
+                return False
+            except FatalIOError as e:
+                self.terminate_slot(slot, RequestStatus.FAILED,
+                                    f"fatal fault growing KV table for "
+                                    f"speculation: {e}")
+                return False
+            except BlockPoolError:
+                return False
+        return True
 
     def pinned(self, req: Request) -> bool:
         """Thrash guard: at the preemption cap a request becomes

@@ -83,6 +83,15 @@ class PagedMixedState(NamedTuple):
                    (``block_tables``/``lens`` then hold this shard's
                    slot rows only, while ``chunk_slot`` stays a global
                    slot id); None on the single-shard path
+      spec_active  [B] int32 — 1 for slots VERIFYING a speculative
+                   draft run this iteration (the third lane of the
+                   mixed step, docs/serving.md "Speculative decoding");
+                   a speculating slot rides the spec rows INSTEAD of
+                   the decode lane (its ``dec_active`` is 0).  None
+                   when ``spec_width`` is 0.
+      spec_width   static Python int — rows per slot in the spec lane
+                   (draft length k + 1); 0 = no spec lane, the
+                   pre-speculation program byte-identical
       k_scale / v_scale  per-row per-head dequant scales (see
                    :class:`PagedKVCache`; None = unquantized pools)
     """
@@ -95,6 +104,8 @@ class PagedMixedState(NamedTuple):
     chunk_start: Any
     chunk_len: Any
     tables_g: Any = None
+    spec_active: Any = None
+    spec_width: int = 0
     k_scale: Any = None
     v_scale: Any = None
 
@@ -854,27 +865,36 @@ class TransformerLM:
 
     def _paged_mixed_attention(self, p, q, k, v, st: PagedMixedState, t,
                                nh, hd):
-        """One layer of the mixed decode+chunked-prefill step.
+        """One layer of the mixed decode+spec-verify+chunked-prefill step.
 
-        q/k/v arrive as ``[1, B + C, nh|kvh, hd]`` — the first B rows
-        are each decode slot's new token, the last C rows are one
-        slot's prompt chunk; rotary was already applied with per-row
-        positions.  Both groups scatter their k/v into the pool in one
-        combined write (decode rows at ``table[len // blk]``, chunk
-        rows at ``base + i`` of the chunk slot's table; inactive/padded
-        rows re-route to the reserved null block), then two kernels
-        attend — the batched decode kernel over all slots and the
-        causal chunk kernel over the chunk slot's pages — and the
-        outputs concatenate back into the shared projection.  A
-        quantized pool (``st.k_scale is not None``) encodes all B + C
-        rows at the combined scatter and both kernels dequantize
-        in-loop (see :meth:`_paged_attention`)."""
+        q/k/v arrive as ``[1, B + B*S + C, nh|kvh, hd]`` — the first B
+        rows are each decode slot's new token, the next B*S rows
+        (slot-major; S = ``st.spec_width``, 0 when the spec lane is
+        off) are each slot's speculative draft run, and the last C rows
+        are one slot's prompt chunk; rotary was already applied with
+        per-row positions.  All groups scatter their k/v into the pool
+        in one combined write (decode rows at ``table[len // blk]``,
+        spec row i of slot b at position ``lens[b] + i``, chunk rows at
+        ``base + i`` of the chunk slot's table; inactive/padded rows
+        re-route to the reserved null block), then the kernels attend —
+        the batched decode kernel over all slots, one decode-kernel
+        call per spec depth (row i sees the slot's prefix plus draft
+        tokens 0..i: causality via the length vector), and the causal
+        chunk kernel over the chunk slot's pages — and the outputs
+        concatenate back into the shared projection.  A quantized pool
+        (``st.k_scale is not None``) encodes every row at the combined
+        scatter and all kernels dequantize in-loop (see
+        :meth:`_paged_attention`).  ``S == 0`` and ``C == 0`` are
+        STATIC widths: the corresponding lane compiles away entirely,
+        so the plain decode program is byte-identical to pre-spec
+        builds."""
         pool_k, pool_v, tables, lens = (st.k_pool, st.v_pool,
                                         st.block_tables, st.lens)
         kscale, vscale = st.k_scale, st.v_scale
         kv_bits = self._paged_kv_bits(pool_k, kscale, hd)
         bsl = lens.shape[0]                   # decode slots
-        c = t - bsl                           # chunk width
+        sw = st.spec_width                    # spec rows per slot
+        c = t - bsl - bsl * sw                # chunk width
         nb, blk = pool_k.shape[0], pool_k.shape[1]
         npages = tables.shape[1]
         act = st.dec_active > 0
@@ -883,18 +903,38 @@ class TransformerLM:
         # block row 0 for slots not decoding this iteration)
         wd = jnp.where(act, tables[slot, lens // blk] * blk + lens % blk,
                        0)
-        # chunk rows: absolute rows base..base+C-1 of the chunk slot's
-        # table (null block for padding past chunk_len).  chunk_slot is
-        # a GLOBAL slot id: with data-sharded slots it indexes the
-        # gathered tables (st.tables_g), which every shard holds in
-        # full — the chunk work itself is replicated over data.
-        ci = jnp.arange(c)
-        cpos = st.chunk_start + ci
-        ctable = (tables if st.tables_g is None
-                  else st.tables_g)[st.chunk_slot]
-        cpage = jnp.minimum(cpos // blk, npages - 1)
-        wc = jnp.where(ci < st.chunk_len, ctable[cpage] * blk + cpos % blk,
-                       0)
+        writes = [wd]
+        if sw:
+            # spec rows: slot b's draft token i lands at position
+            # lens[b] + i — the same cells a sequential decode would
+            # fill, so accepted tokens are already committed and the
+            # rejected tail is rolled back host-side by simply not
+            # advancing lens past it (stale cells are re-written by the
+            # next run before they can be attended).  Inactive slots
+            # re-route to the null block; the page clamp keeps padded
+            # positions in-table.
+            sact = st.spec_active > 0
+            spos = lens[:, None] + jnp.arange(sw)[None, :]     # [B, S]
+            spage = jnp.minimum(spos // blk, npages - 1)
+            ws = jnp.where(sact[:, None],
+                           jnp.take_along_axis(tables, spage, axis=1)
+                           * blk + spos % blk, 0)
+            writes.append(ws.reshape(-1))
+        if c:
+            # chunk rows: absolute rows base..base+C-1 of the chunk
+            # slot's table (null block for padding past chunk_len).
+            # chunk_slot is a GLOBAL slot id: with data-sharded slots
+            # it indexes the gathered tables (st.tables_g), which every
+            # shard holds in full — the chunk work itself is replicated
+            # over data.
+            ci = jnp.arange(c)
+            cpos = st.chunk_start + ci
+            ctable = (tables if st.tables_g is None
+                      else st.tables_g)[st.chunk_slot]
+            cpage = jnp.minimum(cpos // blk, npages - 1)
+            wc = jnp.where(ci < st.chunk_len,
+                           ctable[cpage] * blk + cpos % blk, 0)
+            writes.append(wc)
         dp = self._dp_axis
 
         def gather_rows(a):
@@ -905,16 +945,35 @@ class TransformerLM:
             # data-axis collective, [B_local, kvh, hd]-sized per layer
             return a if dp is None else jax.lax.all_gather(
                 a, dp, axis=0, tiled=True)
-        write = jnp.concatenate([gather_rows(wd), wc])
+
+        def shard_cat(rows):
+            # re-tile the slot-owned segments (decode, spec) to global
+            # slot order and keep the chunk segment as-is.  Spec rows
+            # are slot-major [B_local * S], so a tiled all_gather
+            # yields the global slot-major layout directly.
+            parts = [gather_rows(rows[0])]
+            if sw:
+                parts.append(gather_rows(rows[1]))
+            if c:
+                parts.append(rows[-1])
+            return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+        def seg(a):
+            # split a [B + B*S + C, ...] row array into lane segments
+            out = [a[:bsl]]
+            if sw:
+                out.append(a[bsl:bsl + bsl * sw])
+            if c:
+                out.append(a[bsl + bsl * sw:])
+            return out
+        write = shard_cat(writes)
         flat = (nb * blk,) + pool_k.shape[2:]
         if kv_bits:
             from ..ops.quantizer.quantizer import kv_quantize
-            kq, ks = kv_quantize(k[0], kv_bits)   # [B+C,kvh,De],[B+C,kvh]
+            kq, ks = kv_quantize(k[0], kv_bits)   # [T,kvh,De],[T,kvh]
             vq, vs = kv_quantize(v[0], kv_bits)
-            kq = jnp.concatenate([gather_rows(kq[:bsl]), kq[bsl:]])
-            vq = jnp.concatenate([gather_rows(vq[:bsl]), vq[bsl:]])
-            ks = jnp.concatenate([gather_rows(ks[:bsl]), ks[bsl:]])
-            vs = jnp.concatenate([gather_rows(vs[:bsl]), vs[bsl:]])
+            kq, vq = shard_cat(seg(kq)), shard_cat(seg(vq))
+            ks, vs = shard_cat(seg(ks)), shard_cat(seg(vs))
             sflat = (nb * blk,) + kscale.shape[2:]
             pool_k = pool_k.reshape(flat).at[write].set(
                 kq).reshape(pool_k.shape)
@@ -926,10 +985,8 @@ class TransformerLM:
                 vs).reshape(st.v_scale.shape)
             pk, pv = pool_k, pool_v
         else:
-            kw = k[0].astype(pool_k.dtype)
-            vw = v[0].astype(pool_v.dtype)
-            kw = jnp.concatenate([gather_rows(kw[:bsl]), kw[bsl:]])
-            vw = jnp.concatenate([gather_rows(vw[:bsl]), vw[bsl:]])
+            kw = shard_cat(seg(k[0].astype(pool_k.dtype)))
+            vw = shard_cat(seg(v[0].astype(pool_v.dtype)))
             pool_k = pool_k.reshape(flat).at[write].set(
                 kw).reshape(pool_k.shape)
             pool_v = pool_v.reshape(flat).at[write].set(
@@ -938,19 +995,35 @@ class TransformerLM:
             pv = pool_v.astype(q.dtype)
         from ..ops.transformer.paged_decode_attention import (
             paged_decode_attention, paged_prefill_attention)
-        o_dec = paged_decode_attention(
+        o_parts = [paged_decode_attention(
             q[0, :bsl], pk, pv,
             # only slots decoding THIS iteration attend (their length
             # includes the just-written token); prefilling and empty
             # slots are masked to zero rows
             jnp.where(act, lens + 1, 0), tables,
             sm_scale=self._attn_scale,
-            k_scale=kscale, v_scale=vscale, kv_bits=kv_bits)
-        o_chunk = paged_prefill_attention(
-            q[0, bsl:], pk, pv, st.chunk_start, st.chunk_len, ctable,
-            sm_scale=self._attn_scale,
-            k_scale=kscale, v_scale=vscale, kv_bits=kv_bits)
-        o = jnp.concatenate([o_dec, o_chunk], axis=0)[None]
+            k_scale=kscale, v_scale=vscale, kv_bits=kv_bits)]
+        if sw:
+            # spec depth i attends the prefix plus draft rows 0..i
+            # (all already in the pool from the combined scatter);
+            # per-depth lengths give exact causality between draft rows
+            qs = q[0, bsl:bsl + bsl * sw].reshape(bsl, sw, nh, hd)
+            o_spec = [paged_decode_attention(
+                qs[:, i], pk, pv,
+                jnp.where(sact, lens + i + 1, 0), tables,
+                sm_scale=self._attn_scale,
+                k_scale=kscale, v_scale=vscale, kv_bits=kv_bits)
+                for i in range(sw)]
+            o_parts.append(jnp.stack(o_spec, axis=1).reshape(
+                bsl * sw, nh, hd))
+        if c:
+            o_parts.append(paged_prefill_attention(
+                q[0, bsl + bsl * sw:], pk, pv, st.chunk_start,
+                st.chunk_len, ctable,
+                sm_scale=self._attn_scale,
+                k_scale=kscale, v_scale=vscale, kv_bits=kv_bits))
+        o = (o_parts[0] if len(o_parts) == 1
+             else jnp.concatenate(o_parts, axis=0))[None]
         o = o.reshape(1, t, nh * hd)
         pools = (pool_k, pool_v) if not kv_bits else \
             (pool_k, pool_v, kscale, vscale)
@@ -1318,34 +1391,58 @@ class TransformerLM:
         return self._project(params, x), new_cache
 
     def _apply_paged_mixed(self, params, cache, dec_tokens, dec_active,
-                           chunk_ids, chunk_slot, chunk_start, chunk_len):
+                           chunk_ids, chunk_slot, chunk_start, chunk_len,
+                           spec_tokens=None, spec_active=None):
         """Mixed continuous-batching step: one decode token per active
         slot PLUS one ``chunk_ids``-sized chunk of a single slot's
-        prompt, in ONE program (Sarathi-Serve chunked prefill — the
-        prefill never monopolizes an iteration and the program shape is
-        independent of the prompt-length distribution).
+        prompt PLUS (optionally) a speculative verify run per slot, in
+        ONE program (Sarathi-Serve chunked prefill — the prefill never
+        monopolizes an iteration and the program shape is independent
+        of the prompt-length distribution; the spec lane is Leviathan
+        et al.'s verify step batched over slots).
 
         ``cache``: {"k"/"v": [L, num_blocks, block, kv_heads, hd] pools,
         "block_tables": [B, pages] int32, "lens": [B] int32 (rows
         already in the pool per slot)}.  ``dec_tokens``/``dec_active``
         [B] int32; ``chunk_ids`` [C] int32 (padded with anything past
-        ``chunk_len``); ``chunk_slot``/``chunk_start``/``chunk_len``
-        int32 scalars.  Returns ``(dec_logits [B, V], chunk_logits [V]
-        — the chunk's LAST VALID position, the first-token sample point
-        when the chunk completes a prefix, new_cache)``."""
+        ``chunk_len``; C may be STATICALLY 0 — the chunk lane then
+        compiles away); ``chunk_slot``/``chunk_start``/``chunk_len``
+        int32 scalars.  ``spec_tokens`` [B, S] int32 arms the spec
+        lane: row b holds the slot's last emitted token followed by
+        draft proposals d_1..d_{S-1}, fed at positions lens[b]..
+        lens[b]+S-1; ``spec_active`` [B] selects the verifying slots
+        (their ``dec_active`` must be 0).  Returns ``(dec_logits
+        [B, V], chunk_logits [V] — the chunk's LAST VALID position, the
+        first-token sample point when the chunk completes a prefix,
+        new_cache)``, with ``spec_logits [B, S, V]`` inserted after
+        ``dec_logits`` when the spec lane is armed."""
         reason = self._paged_supported()
         if reason is not None:
             raise NotImplementedError(reason)
         tables, lens = cache["block_tables"], cache["lens"]
         quant = cache.get("k_scale") is not None
         bsl = dec_tokens.shape[0]
+        sw = 0 if spec_tokens is None else spec_tokens.shape[1]
         c = chunk_ids.shape[0]
-        ci = jnp.arange(c)
-        # clamp padded chunk positions to 0: base + i past chunk_len can
-        # exceed the rotary/learned position tables near max_seq_len
-        cpos = jnp.where(ci < chunk_len, chunk_start + ci, 0)
-        positions = jnp.concatenate([lens, cpos])[None]    # [1, B+C]
-        ids = jnp.concatenate([dec_tokens, chunk_ids])[None]
+        pos_parts, id_parts = [lens], [dec_tokens]
+        if sw:
+            # spec positions: lens[b] + i for verifying slots; parked
+            # at 0 for the rest (null-block rows, position clamped away
+            # from the table edge like padded chunk rows)
+            spos = jnp.where((spec_active > 0)[:, None],
+                             lens[:, None] + jnp.arange(sw)[None, :], 0)
+            pos_parts.append(spos.reshape(-1))
+            id_parts.append(spec_tokens.reshape(-1))
+        if c:
+            ci = jnp.arange(c)
+            # clamp padded chunk positions to 0: base + i past chunk_len
+            # can exceed the rotary/learned position tables near
+            # max_seq_len
+            cpos = jnp.where(ci < chunk_len, chunk_start + ci, 0)
+            pos_parts.append(cpos)
+            id_parts.append(chunk_ids)
+        positions = jnp.concatenate(pos_parts)[None]   # [1, B+B*S+C]
+        ids = jnp.concatenate(id_parts)[None]
         x = self._embed_tokens(params, ids, positions=positions)
         # data-sharded decode slots: the chunk indexes a GLOBAL slot, so
         # gather the full block tables ONCE here (they are loop
@@ -1355,7 +1452,7 @@ class TransformerLM:
                     jax.lax.all_gather(tables, self._dp_axis, axis=0,
                                        tiled=True))
         st_args = (tables, lens, dec_active, chunk_slot, chunk_start,
-                   chunk_len, tables_g)
+                   chunk_len, tables_g, spec_active, sw)
 
         def scan_fn(carry, xs):
             bp, *pools = xs
@@ -1371,18 +1468,26 @@ class TransformerLM:
         x, pools = jax.lax.scan(scan_fn, x, xs)
         if self.config.final_layernorm:
             x = self._norm_fn()(params["ln_f"], x)
-        # project only the rows anything samples from: the B decode rows
-        # and the chunk's last valid position (a [B+1, V] head instead
-        # of [B+C, V])
-        last = jax.lax.dynamic_slice_in_dim(
-            x[0], bsl + jnp.maximum(chunk_len - 1, 0), 1, axis=0)
-        logits = self._project(params,
-                               jnp.concatenate([x[0, :bsl], last])[None])
+        # project only the rows anything samples from: the B decode
+        # rows, the B*S spec rows, and the chunk's last valid position
+        # (a [B + B*S + 1, V] head instead of [B + B*S + C, V])
+        nsample = bsl + bsl * sw
+        if c:
+            last = jax.lax.dynamic_slice_in_dim(
+                x[0], nsample + jnp.maximum(chunk_len - 1, 0), 1, axis=0)
+            logits = self._project(
+                params, jnp.concatenate([x[0, :nsample], last])[None])
+            chunk_logits = logits[0, nsample]
+        else:
+            logits = self._project(params, x[0, :nsample][None])
+            chunk_logits = jnp.zeros((logits.shape[-1],), logits.dtype)
         new_lens = lens + (dec_active > 0).astype(lens.dtype)
         # with data-sharded slots `lens` is this shard's rows and
         # chunk_slot is global: translate to the local row, dropping the
         # update on shards that don't own the chunk slot (the serving
-        # engine recomputes lens host-side every dispatch either way)
+        # engine recomputes lens host-side every dispatch either way —
+        # including the spec lane's accepted-token advance, which only
+        # the host knows after the accept/reject compare)
         cs = (chunk_slot if self._dp_axis is None else
               chunk_slot - jax.lax.axis_index(self._dp_axis) * bsl)
         new_lens = new_lens.at[cs].add(chunk_len, mode="drop")
@@ -1390,7 +1495,11 @@ class TransformerLM:
                      "lens": new_lens}
         if quant:
             new_cache["k_scale"], new_cache["v_scale"] = pools[2], pools[3]
-        return logits[0, :bsl], logits[0, bsl], new_cache
+        if sw:
+            spec_logits = logits[0, bsl:nsample].reshape(
+                bsl, sw, logits.shape[-1])
+            return (logits[0, :bsl], spec_logits, chunk_logits, new_cache)
+        return logits[0, :bsl], chunk_logits, new_cache
 
     def init_paged_cache(self, num_blocks: int, block_size: int,
                          dtype=None, kv_bits: int = 0) -> Dict:

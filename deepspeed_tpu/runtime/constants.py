@@ -139,6 +139,12 @@ SERVING_MAX_PREEMPTIONS_DEFAULT = 8
 # transitions while work remains) raise a loud ServingError with full
 # scheduler diagnostics; 0 disables
 SERVING_NO_PROGRESS_STEPS_DEFAULT = 64
+# speculative decoding draft depth: the draft model proposes this many
+# tokens per speculating slot per iteration (plus one KV-only step);
+# the target verifies them in ONE batched dispatch and emits
+# 1..spec_k+1 tokens, token-exact vs plain decode under the same key.
+# Only read when serving_engine(draft_model=...) arms a draft.
+SERVING_SPEC_K_DEFAULT = 3
 # default per-request TTL (submit -> terminal), swept every step() for
 # WAITING and RUNNING requests; 0 = no deadline. submit(deadline_s=...)
 # overrides per request.

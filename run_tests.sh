@@ -58,6 +58,7 @@ for f in tests/unit/test_*.py; do
   fi
   if [[ "$f" == *test_resilience.py || "$f" == *test_observability.py \
         || "$f" == *test_serving.py || "$f" == *test_serving_tp.py \
+        || "$f" == *test_frontend.py \
         || "$f" == *test_training_perf.py ]]; then
     continue   # each runs once in its marker sweep below, not twice
   fi
@@ -124,6 +125,24 @@ if [[ -z "$FILTER" || "inference" == *"$FILTER"* || "serving" == *"$FILTER"* ]];
     PASSED=$((PASSED + 1))
   else
     FAILED+=("pytest -m inference")
+  fi
+fi
+
+# Front-end sweep: the SLO multi-tenant front-end suite — greedy AND
+# seeded-sampled stream parity vs generate() (the shared
+# inference/sampling.py fold_in schedule), streaming lifecycle events,
+# VTC fairness math + starvation bound, shed-policy victim selection,
+# speculative-decoding token-exactness vs the plain engine, and
+# (1,1)-vs-(2,2) mesh determinism with sampling+spec on — one compiled
+# program across every feature mix (pytest.ini `frontend` marker;
+# docs/serving.md "Sampling, streaming & multi-tenant SLOs").
+if [[ -z "$FILTER" || "frontend" == *"$FILTER"* || "serving" == *"$FILTER"* ]]; then
+  echo "=== frontend marker sweep (pytest -m frontend)"
+  if JAX_PLATFORMS=cpu python -m pytest tests/unit/test_frontend.py \
+       -m frontend -q --tb=short ${EXTRA_PYTEST_ARGS:-}; then
+    PASSED=$((PASSED + 1))
+  else
+    FAILED+=("pytest -m frontend")
   fi
 fi
 

@@ -29,6 +29,7 @@ def base_cfg():
 
 
 class TestResourceManager:
+    @pytest.mark.slow
     def test_crash_hang_ok_isolation(self, tmp_path):
         """One ok spec, one crashing spec, one hanging spec — the pool
         completes, each with the right classification."""
@@ -51,6 +52,7 @@ class TestResourceManager:
 
 
 class TestScheduledTune:
+    @pytest.mark.slow
     def test_eight_candidates_one_crash_ranked_report(self, tmp_path):
         """VERDICT r3 #6 'Done' condition: >=8 candidates, one crashes,
         the tune completes and writes a ranked report."""

@@ -290,6 +290,7 @@ class TestCommBenchmarks:
 
 
 class TestAutotuner:
+    @pytest.mark.slow
     def test_tune_picks_working_config(self):
         from deepspeed_tpu.autotuning import Autotuner
         model = tiny_model()
@@ -394,6 +395,7 @@ class TestAutotunerWidened:
         assert any(e["cfg"]["zero_optimization"].get("offload_optimizer")
                    for e in exps)
 
+    @pytest.mark.slow
     def test_tune_picks_and_reports_statuses(self):
         from deepspeed_tpu.autotuning import Autotuner
         rs = np.random.RandomState(0)

@@ -47,6 +47,7 @@ def params_allclose(a, b, atol=1e-6):
 
 class TestCheckpointRoundTrip:
     @pytest.mark.parametrize("stage", [0, 2])
+    @pytest.mark.slow
     def test_same_topology(self, stage, tmp_path):
         e1 = make_engine(stage)
         for i in range(3):
@@ -65,6 +66,7 @@ class TestCheckpointRoundTrip:
         np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
                                    rtol=1e-6)
 
+    @pytest.mark.slow
     def test_topology_change_dp_to_dp_tp(self, tmp_path):
         """Elastic/universal semantics: save at dp=8, load at dp=4×tp=2
         (reference needs the offline reshape library for this)."""

@@ -113,6 +113,7 @@ class TestTwoNodeCluster:
         losses = [r["loss"] for r in rows]
         assert all(b < a for a, b in zip(losses, losses[1:]))
 
+    @pytest.mark.slow
     def test_node_death_excluded_by_heartbeat(self, tmp_path):
         """Node b's agent dies mid-generation (stops heartbeating while
         its workers hang): node a detects staleness, re-rendezvouses

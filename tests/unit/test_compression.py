@@ -156,6 +156,7 @@ class TestMasks:
 
 
 class TestTraining:
+    @pytest.mark.slow
     def test_prune_then_finetune_converges(self):
         import deepspeed_tpu as ds
         model = tiny_model()

@@ -541,6 +541,7 @@ def synth_unet_sd(cfg):
 
 
 class TestLoaders:
+    @pytest.mark.slow
     def test_unet_loader_roundtrip(self):
         cfg = tiny_unet_cfg()
         sd = synth_unet_sd(cfg)

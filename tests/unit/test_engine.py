@@ -54,6 +54,7 @@ class TestBasicTraining:
         _, losses = run_steps(base_config(), n=5)
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow
     def test_gas_equivalence(self):
         """Same global batch, different gas split → same trajectory."""
         _, l1 = run_steps(base_config(train_micro_batch_size_per_gpu=2))
@@ -67,6 +68,7 @@ class TestBasicTraining:
         for k in ("loss", "lr", "grad_norm", "overflow"):
             assert k in m
 
+    @pytest.mark.slow
     def test_grad_clipping_applied(self):
         """The reported grad_norm is the PRE-clip global norm, and with a
         LINEAR optimizer (SGD — Adam's normalizer hides the scale) the
@@ -98,6 +100,7 @@ class TestZeroParity:
     """Stages must agree step-for-step (fp32 exact-ish)."""
 
     @pytest.mark.parametrize("stage", [1, 2, 3])
+    @pytest.mark.slow
     def test_stage_matches_stage0(self, stage):
         _, l0 = run_steps(base_config(), n=3)
         _, ls = run_steps(base_config(
@@ -121,6 +124,7 @@ class TestZeroParity:
         assert spec[0] is None          # scan/layer axis never sharded
         assert "data" in str(spec)
 
+    @pytest.mark.slow
     def test_stage3_param_persistence_threshold(self):
         """Params below the threshold stay resident (replicated) — the
         reference's persisted-param set (stage3_param_persistence_threshold,
@@ -134,6 +138,7 @@ class TestZeroParity:
             "stage": 3, "param_persistence_threshold": 0}), n=2)
         np.testing.assert_allclose(losses, ref, rtol=1e-4)
 
+    @pytest.mark.slow
     def test_zero_with_tp_mesh(self):
         cfg = base_config(mesh={"data": 4, "model": 2},
                           zero_optimization={"stage": 2})
@@ -171,6 +176,7 @@ class TestMixedPrecision:
 
 
 class TestCompatAPI:
+    @pytest.mark.slow
     def test_forward_backward_step(self):
         engine, _, _, _ = ds.initialize(model=tiny_model(),
                                         config=base_config(),
@@ -220,6 +226,7 @@ class TestBatchReconciliation:
 
 
 class TestGraftEntry:
+    @pytest.mark.slow
     def test_dryrun_multichip(self):
         import importlib.util
         spec = importlib.util.spec_from_file_location(

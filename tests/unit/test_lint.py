@@ -1028,6 +1028,7 @@ def test_analyzer_clean_on_own_source():
     assert fs == [], "\n".join(f.render() for f in fs)
 
 
+@pytest.mark.slow
 def test_single_parse_matches_per_family_parse():
     """Byte-identical findings from the shared-symbol-table run vs a
     fresh parse per family — pins that the PR 7 single-parse refactor

@@ -197,6 +197,7 @@ class TestHostActivationCheckpointing:
         b = {"input_ids": rs.randint(0, 64, (8, 32), dtype=np.int32)}
         return [float(engine.train_step(b)["loss"]) for _ in range(n)]
 
+    @pytest.mark.slow
     def test_matches_full_remat_trajectory(self):
         """Offloading residuals must not change the math: loss
         trajectory identical to remat='full' (same recompute, different

@@ -151,6 +151,7 @@ class TestMoETraining:
             batch(engine.train_batch_size, seed=i))["loss"])
             for i in range(steps)]
 
+    @pytest.mark.slow
     def test_ep_matches_dp(self):
         """Same model, same data: pure-DP mesh vs expert-parallel mesh must
         produce identical losses (EP is a layout, not a different program)."""
@@ -158,11 +159,13 @@ class TestMoETraining:
         _, ep = self._train({"data": 2, "expert": 4})
         np.testing.assert_allclose(dp, ep, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_ep_with_tp(self):
         _, dp = self._train({"data": 8})
         _, ep_tp = self._train({"data": 2, "expert": 2, "model": 2})
         np.testing.assert_allclose(dp, ep_tp, rtol=2e-3)
 
+    @pytest.mark.slow
     def test_top2_trains(self):
         _, losses = self._train({"data": 2, "expert": 4}, k=2)
         assert all(np.isfinite(losses))
@@ -172,18 +175,21 @@ class TestMoETraining:
         _, losses = self._train({"data": 2, "expert": 4}, freq=1)
         assert all(np.isfinite(losses))
 
+    @pytest.mark.slow
     def test_moe_with_zero2(self):
         _, z0 = self._train({"data": 2, "expert": 4})
         _, z2 = self._train({"data": 2, "expert": 4},
                             zero_optimization={"stage": 2})
         np.testing.assert_allclose(z0, z2, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_expert_params_sharded(self):
         engine, _ = self._train({"data": 2, "expert": 4}, steps=1)
         specs = engine.zero_policy.param_specs
         blk = specs["blocks"]["moe_blk"]["moe"]["experts"]
         assert blk["fc_in"]["kernel"][1] == "expert"
 
+    @pytest.mark.slow
     def test_rsample_rts_via_engine_rng(self):
         """batch['moe_rng'] reaches the gate through shard_batch + GAS scan:
         RSample/RTS configs train, and the key changes the routing."""
@@ -221,6 +227,7 @@ class TestMoETraining:
                         "steps_per_print": 0},
                 mesh=mesh, rng=jax.random.PRNGKey(0))
 
+    @pytest.mark.slow
     def test_moe_under_pipeline(self):
         """PP(2) × EP(2) × DP(2) matches pure DP — the pipeline loop must
         accumulate MoE aux loss only on valid (non-bubble) ticks."""
@@ -244,6 +251,7 @@ class TestMoETraining:
             for i in range(3)]
         np.testing.assert_allclose(dp, pp, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_moe_checkpoint_roundtrip(self, tmp_path):
         engine, losses = self._train({"data": 2, "expert": 4}, steps=2)
         engine.save_checkpoint(str(tmp_path), tag="m1")
@@ -267,6 +275,7 @@ class TestMoEInference:
             max_seq_len=64, loss_chunk=0, dtype=jnp.float32,
             moe_num_experts=4, moe_freq=2, moe_k=1, moe_use_rts=False))
 
+    @pytest.mark.slow
     def test_generate_runs_and_matches_forward_argmax(self):
         import deepspeed_tpu as ds
         model = self._moe_model()

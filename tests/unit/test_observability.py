@@ -374,6 +374,7 @@ def batch(n, seed=0):
 
 
 class TestIntegration:
+    @pytest.mark.slow
     def test_training_loop_produces_trace_and_textfile(self, tmp_path):
         """Acceptance: CPU-backend loop with tracing+metrics on → Chrome
         trace with spans from ≥4 subsystems (engine step phases,
@@ -429,6 +430,7 @@ class TestIntegration:
             snap = json.load(f)
         assert snap["dstpu_step_time_seconds"]["count"] >= 3
 
+    @pytest.mark.slow
     def test_metrics_flow_into_monitor_fanout(self, tmp_path):
         """Registry scalars ride MonitorMaster: the CSV backend grows
         Metrics_* files without any backend-specific wiring."""
@@ -449,6 +451,7 @@ class TestIntegration:
         assert "Metrics_dstpu_train_steps_total.csv" in files
         assert "Metrics_dstpu_step_time_seconds.csv" in files
 
+    @pytest.mark.slow
     def test_disabled_block_is_noop(self, tmp_path):
         """With the block absent the tracer is off, trace_span returns
         the shared null singleton, and no telemetry files appear."""

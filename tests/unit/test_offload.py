@@ -95,6 +95,7 @@ class TestOffloadEngine:
             batch(engine.train_batch_size, seed=i))["loss"]
             for i in range(n)]
 
+    @pytest.mark.slow
     def test_offload_matches_device_optimizer(self):
         """fp32 compute: host C++ AdamW must track the in-jit AdamW."""
         _, ref = self._losses(base_config())
@@ -104,6 +105,7 @@ class TestOffloadEngine:
         np.testing.assert_allclose(ref, off, rtol=1e-4)
 
     @pytest.mark.parametrize("bits", [8, 1])
+    @pytest.mark.slow
     def test_offload_wire_codec_tracks_uncompressed(self, bits):
         """r5: the tier-1 D2H grad wire rides the same stochastic-rounded
         codec as ZeRO-Infinity's stream (offload_wire_bits). 8-bit must
@@ -123,6 +125,7 @@ class TestOffloadEngine:
         else:
             assert wired[-1] < wired[0]
 
+    @pytest.mark.slow
     def test_offload_wire_codec_grad_parity_one_step(self):
         """One 8-bit step: every master moves to within the quantization
         noise of the uncompressed step (catches a payload/scale layout bug
@@ -143,6 +146,7 @@ class TestOffloadEngine:
                     "offload_optimizer": {"device": "cpu"},
                     "offload_wire_bits": 3}), rng=jax.random.PRNGKey(0))
 
+    @pytest.mark.slow
     def test_offload_with_zero2(self):
         _, off = self._losses(base_config(
             zero_optimization={"stage": 2,
@@ -151,6 +155,7 @@ class TestOffloadEngine:
         _, ref = self._losses(base_config())
         np.testing.assert_allclose(ref, off, rtol=1e-4)
 
+    @pytest.mark.slow
     def test_offload_bf16(self):
         cfg = base_config(bf16={"enabled": True},
                           zero_optimization={
@@ -164,6 +169,7 @@ class TestOffloadEngine:
         assert leaf.dtype == jnp.bfloat16
         assert "opt" not in engine.state
 
+    @pytest.mark.slow
     def test_offload_checkpoint_roundtrip(self, tmp_path):
         cfg = base_config(zero_optimization={
             "stage": 0, "offload_optimizer": {"device": "cpu"}})

@@ -107,6 +107,7 @@ def _train(opt_cfg, mesh, n=6, seed=0):
 
 class TestOnebitAdamEngine:
 
+    @pytest.mark.slow
     def test_warmup_matches_plain_adam(self):
         """During warmup 1-bit Adam IS Adam (exact pmean) — loss
         trajectories must match the plain engine."""
@@ -121,6 +122,7 @@ class TestOnebitAdamEngine:
             {"dcn_data": 2, "data": 4}, n=3)
         np.testing.assert_allclose(ref, ob, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_compression_phase_trains(self):
         engine, losses = _train(
             {"type": "OnebitAdam", "params": {"lr": 1e-3,
@@ -132,6 +134,7 @@ class TestOnebitAdamEngine:
         # descent is noise; divergence would blow past this band)
         assert losses[-1] < losses[0] + 0.05
 
+    @pytest.mark.slow
     def test_convergence_parity_with_adam(self):
         """End-to-end: 1-bit (freeze 3) final loss within 2% of Adam's
         after 10 steps (reference onebit convergence tests)."""
@@ -145,6 +148,7 @@ class TestOnebitAdamEngine:
             {"dcn_data": 2, "data": 4}, n=10)
         assert abs(ob[-1] - ref[-1]) / ref[-1] < 0.02, (ref[-1], ob[-1])
 
+    @pytest.mark.slow
     def test_fp16_loss_scaled_trains(self):
         """fp16 x 1-bit (reference fp16/onebit/adam.py under
         FP16_Optimizer): loss-scaled grads, skip-on-overflow, and the
@@ -220,6 +224,7 @@ class TestZeroOneSchedule:
 
 
 class TestZeroOneAdamEngine:
+    @pytest.mark.slow
     def test_var_phase_matches_plain_adam(self):
         """With var_interval stuck at 1 (huge var_update_scaler), every
         phase-1 step is a full-precision variance update == exact Adam."""
@@ -234,6 +239,7 @@ class TestZeroOneAdamEngine:
             {"dcn_data": 2, "data": 4}, n=3)
         np.testing.assert_allclose(ref, zo, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_all_four_programs_run_and_train(self):
         engine, losses = _train(
             {"type": "ZeroOneAdam", "params": {
@@ -261,6 +267,7 @@ class TestZeroOneAdamEngine:
 
 
 class TestOnebitLambEngine:
+    @pytest.mark.slow
     def test_warmup_matches_plain_lamb(self):
         _, ref = _train(
             {"type": "Lamb", "params": {"lr": 1e-3}},
@@ -280,6 +287,7 @@ class TestOnebitLambEngine:
         assert engine._onebit_key == "compress"
         assert losses[-1] < losses[0] + 0.05
 
+    @pytest.mark.slow
     def test_scaling_coeffs_set_at_freeze(self):
         engine, _ = _train(
             {"type": "OnebitLamb", "params": {"lr": 1e-3,

@@ -152,12 +152,14 @@ class TestPipelineEngine:
             fixed_batch(engine.train_batch_size, seed=i))["loss"])
             for i in range(n)]
 
+    @pytest.mark.slow
     def test_pp2_matches_dp(self):
         ref = self._dp_reference_losses()
         _, pp = self._pp_losses({"pipe": 2, "data": 4})
         np.testing.assert_allclose(ref, pp, rtol=2e-4)
 
     @pytest.mark.parametrize("sched", ["1f1b", "gpipe"])
+    @pytest.mark.slow
     def test_pp2_attention_layers_matches_dp(self, sched):
         """GPT-Neo-style per-layer local windows must survive the pipeline
         stage split: each stage applies ITS slice of the window vector.
@@ -181,22 +183,26 @@ class TestPipelineEngine:
             for i in range(3)]
         np.testing.assert_allclose(ref, pp, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_pp4_matches_dp(self):
         ref = self._dp_reference_losses()
         _, pp = self._pp_losses({"pipe": 4, "data": 2})
         np.testing.assert_allclose(ref, pp, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_pp_with_tp(self):
         ref = self._dp_reference_losses()
         _, pp = self._pp_losses({"pipe": 2, "data": 2, "model": 2})
         np.testing.assert_allclose(ref, pp, rtol=2e-3)
 
+    @pytest.mark.slow
     def test_pp_with_zero1(self):
         """BLOOM-style ZeRO-1 × PP (reference supports ZeRO-1 with pipe)."""
         ref = self._dp_reference_losses()
         _, pp = self._pp_losses({"pipe": 2, "data": 4}, stage=1)
         np.testing.assert_allclose(ref, pp, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_pp_fp16_scale_invariant(self):
         """fp16 pipeline: the update must be invariant to the loss scale —
         the loss is scaled before autodiff and the grads divided back by the
@@ -219,6 +225,7 @@ class TestPipelineEngine:
         # scale multiply shows up as a 256x-smaller update by step 2.
         np.testing.assert_allclose(losses[0], losses[8], rtol=5e-3)
 
+    @pytest.mark.slow
     def test_gpipe_schedule_matches_1f1b(self):
         """Both compiled schedules are the same math — losses must agree
         (and both match DP, transitively)."""
@@ -236,6 +243,7 @@ class TestPipelineEngine:
                 for i in range(3)]
         np.testing.assert_allclose(out["gpipe"], out["1f1b"], rtol=2e-4)
 
+    @pytest.mark.slow
     def test_3d_with_sharded_embeddings(self):
         """pp x dp x tp with the one-hot TP embedding: the embedding table
         must actually be SHARDED over 'model' under PP (the BLOOM-3D

@@ -157,6 +157,7 @@ class TestSequenceParallelTraining:
             out.append(float(engine.train_step(b)["loss"]))
         return out
 
+    @pytest.mark.slow
     def test_ring_training_matches_dense(self):
         """SP(4) x DP(2) ring-attention training == single-program XLA
         attention (same seeds) — the VERDICT's required numerics check."""
@@ -164,6 +165,7 @@ class TestSequenceParallelTraining:
         ring = self._losses(self._model("ring"), {"data": 2, "sequence": 4})
         np.testing.assert_allclose(ref, ring, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_ulysses_training_matches_dense(self):
         """SP(4) x DP(2) Ulysses training == single-program XLA attention
         (same seeds) — the same numerics bar as ring."""

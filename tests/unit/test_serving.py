@@ -780,6 +780,7 @@ class TestServingEngine:
         with pytest.raises(ValueError, match="max_out_tokens"):
             srv.submit(list(range(60)), max_new_tokens=30)
 
+    @pytest.mark.slow
     def test_single_request_matches_generate(self):
         eng, srv = serving_engine()
         rs = np.random.RandomState(0)
@@ -791,6 +792,7 @@ class TestServingEngine:
                                        temperature=0.0))[0]
         np.testing.assert_array_equal(np.asarray(req.output), want)
 
+    @pytest.mark.slow
     def test_integration_staggered_8_requests_single_trace(self):
         """The acceptance pin: 8 concurrent requests with staggered
         arrivals, every token stream identical to sequential
@@ -819,6 +821,7 @@ class TestServingEngine:
         srv.allocator.assert_consistent()
         assert srv.allocator.num_used == 0
 
+    @pytest.mark.slow
     def test_preemption_preserves_streams(self):
         """A pool too small for the offered load forces recompute
         preemption; streams still match sequential generate and the
@@ -858,6 +861,7 @@ class TestServingEngine:
         srv.run(max_steps=50)
         assert req.output == list(want[:first + 1])
 
+    @pytest.mark.slow
     def test_gqa_serving_matches_generate(self):
         from deepspeed_tpu.models.transformer import TransformerConfig
         cfg = TransformerConfig(
@@ -877,6 +881,7 @@ class TestServingEngine:
                              max_new_tokens=6, temperature=0.0))[0]
             np.testing.assert_array_equal(np.asarray(r.output), want)
 
+    @pytest.mark.slow
     def test_int8_weights_serve_through_paged_path(self):
         """Quantized serving composes: the per-layer {q, s} block tree
         rides the paged decode scan the same way it rides dense decode,
@@ -904,6 +909,7 @@ class TestServingEngine:
                              max_new_tokens=5, temperature=0.0))[0]
             np.testing.assert_array_equal(np.asarray(r.output), want)
 
+    @pytest.mark.slow
     def test_metrics_instrumented(self):
         """The PR-3 observability wiring: TTFT histogram counts every
         request's first token, gauges return to empty at drain, token
@@ -929,6 +935,7 @@ class TestServingEngine:
         assert reg.histogram(
             "dstpu_serving_inter_token_seconds").count > 0
 
+    @pytest.mark.slow
     def test_multi_chunk_prefill_matches_generate(self):
         """A prompt longer than the chunk budget prefills over several
         iterations (decode running alongside) and still reproduces the
@@ -946,6 +953,7 @@ class TestServingEngine:
                                           err_msg=f"prompt {p}")
         assert srv.decode_builds == 1
 
+    @pytest.mark.slow
     def test_warm_prefix_hits_and_streams_match(self):
         """The RadixAttention claim end-to-end: a second request over a
         shared prompt hits the committed blocks (skipping most of its
@@ -970,6 +978,7 @@ class TestServingEngine:
         assert get_registry().counter(
             "dstpu_serving_prefix_cache_hit_tokens_total").value > 0
 
+    @pytest.mark.slow
     def test_kv8_streams_exact_single_trace_and_prefix_reuse(self):
         """The quantized-KV acceptance pin (ISSUE 8): with
         ``kv_cache_bits=8`` the toy model's greedy streams are
@@ -1001,6 +1010,7 @@ class TestServingEngine:
         srv.allocator.assert_consistent()
         assert srv.allocator.num_used == 0
 
+    @pytest.mark.slow
     def test_kv4_serves_and_drains_clean(self):
         """Packed int4 end-to-end: streams are NOT pinned token-exact
         (4-bit KV on an 8-dim toy head is genuinely lossy) but the
@@ -1017,6 +1027,7 @@ class TestServingEngine:
         assert srv.decode_builds == 1
         assert srv.allocator.num_used == 0
 
+    @pytest.mark.slow
     def test_preempt_resume_recomputes_only_uncached_tail(self):
         """A preempted request's committed blocks park in the cached
         LRU; its re-admission hits them, so the resume pays only the
@@ -1047,6 +1058,7 @@ class TestServingEngine:
         assert srv.decode_builds == 1
         assert srv.allocator.num_used == 0
 
+    @pytest.mark.slow
     def test_staggered_preemption_acceptance(self):
         """The extended acceptance pin: 8 staggered requests on an
         undersized pool (forced preemption), prefix caching and chunked
@@ -1281,6 +1293,7 @@ class TestCachedPrefixAdmissionEdge:
 
 
 class TestThroughputAccounting:
+    @pytest.mark.slow
     def test_batched_decode_beats_sequential_dispatch_count(self):
         """Continuous batching's throughput lever in dispatch terms: N
         overlapping requests drain in ~(prefills + max tokens) decode

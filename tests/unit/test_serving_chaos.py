@@ -16,6 +16,7 @@ overload" describes the semantics being pinned.
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 import deepspeed_tpu as ds
@@ -38,19 +39,27 @@ def env_injector():
 
 
 def chaos_engine(num_kv_blocks=16, slots=3, max_queue_depth=16,
-                 kv_cache_bits=0):
+                 kv_cache_bits=0, spec_k=None, draft=False):
     cfg = gpt2_config("125m", num_layers=2, d_model=32, num_heads=4,
                       vocab_size=64, max_seq_len=64, dtype=jnp.float32)
+    serving = {"enabled": True, "kv_block_size": 4,
+               "num_kv_blocks": num_kv_blocks,
+               "max_batch_slots": slots,
+               "prefill_chunk_tokens": 8,
+               "max_preemptions": 4,
+               "max_queue_depth": max_queue_depth,
+               "kv_cache_bits": kv_cache_bits}
+    if spec_k is not None:
+        serving["spec_k"] = spec_k
     eng = ds.init_inference(TransformerLM(cfg), config={
         "dtype": "float32", "max_out_tokens": 48, "temperature": 0.0,
-        "replace_with_kernel_inject": False,
-        "serving": {"enabled": True, "kv_block_size": 4,
-                    "num_kv_blocks": num_kv_blocks,
-                    "max_batch_slots": slots,
-                    "prefill_chunk_tokens": 8,
-                    "max_preemptions": 4,
-                    "max_queue_depth": max_queue_depth,
-                    "kv_cache_bits": kv_cache_bits}})
+        "replace_with_kernel_inject": False, "serving": serving})
+    if draft:
+        dm = TransformerLM(gpt2_config(
+            "125m", num_layers=1, d_model=32, num_heads=4,
+            vocab_size=64, max_seq_len=64, dtype=jnp.float32))
+        return eng, eng.serving_engine(
+            draft_model=dm, draft_params=dm.init(jax.random.PRNGKey(3)))
     return eng, eng.serving_engine()
 
 
@@ -148,6 +157,65 @@ def test_chaos_staged_faults_cancels_deadlines(env_injector,
             np.testing.assert_array_equal(
                 np.asarray(r.output), _generate(eng, p, new),
                 err_msg=f"prompt {p} (status {r.status})")
+
+
+def test_chaos_sampled_spec_staged_faults(env_injector):
+    """The front-end stack under the same staged chaos: seeded SAMPLED
+    requests (mixed greedy / temperature / top-k, per-request seeds)
+    over a DRAFT-ARMED engine — deadline expiry, mid-flight cancel and
+    a NaN-poisoned slot land while the speculative lane is live.  The
+    drain must satisfy the standard invariants (one compiled program,
+    clean pool, coherent lifecycle counters), the speculative counters
+    must have moved, and every OK stream must be token-exact against
+    seeded sequential ``generate()`` with the same sampling config —
+    the fold_in(key, j) schedule makes the stream independent of
+    batching, preemption AND how many tokens each verified round
+    emitted."""
+    eng, srv = chaos_engine(spec_k=2, draft=True)
+    rs = np.random.RandomState(2027)
+    new = 8
+    prompts = [rs.randint(0, 64, (n,)).tolist()
+               for n in (5, 9, 12, 7, 3, 10, 6, 8)]
+    samp = [{"temperature": 0.0} if i % 3 == 0 else
+            {"temperature": 0.8, "top_k": 12, "seed": 500 + i}
+            for i in range(len(prompts))]
+    reqs = [srv.submit(p, max_new_tokens=new, **s)
+            for p, s in zip(prompts[:4], samp[:4])]
+    reqs[3].deadline_s = 1.0
+    reqs[3].submit_time -= 50.0
+    srv.step()
+    srv.step()
+    cancel_target = next((r for r in reqs
+                          if r.state is RequestState.RUNNING
+                          and r.status is None), None)
+    if cancel_target is not None:
+        assert srv.cancel(cancel_target)
+    reqs += [srv.submit(p, max_new_tokens=new, **s)
+             for p, s in zip(prompts[4:], samp[4:])]
+    srv.step()
+    poison = next((r for r in reqs
+                   if r.state is RequestState.RUNNING and r.status is None
+                   and not r.prefilling and len(r.output) < new - 2), None)
+    if poison is not None:
+        poison_slot_kv(srv, poison)
+    finished = srv.run()
+
+    assert_drained_clean(srv, reqs, finished)
+    assert reqs[3].status is RequestStatus.TIMED_OUT
+    assert srv.spec_counts["proposed"] > 0, "draft lane never ran"
+    affected = sum(1 for r in reqs if r.status is not RequestStatus.OK)
+    assert affected >= 2, "chaos exercised nothing"
+    assert affected < len(reqs), "no unaffected streams left to check"
+    for p, r, s in zip(prompts, reqs, samp):
+        if r.status is not RequestStatus.OK:
+            continue
+        kw = dict(s)
+        rng = jax.random.PRNGKey(kw.pop("seed")) if "seed" in kw else None
+        ref = np.asarray(eng.generate(
+            np.asarray(p, np.int32)[None], max_new_tokens=new,
+            rng=rng, **kw))[0]
+        np.testing.assert_array_equal(np.asarray(r.output), ref,
+                                      err_msg=f"prompt {p} samp {s}")
 
 
 def test_chaos_randomized_interleaving(env_injector):

@@ -121,6 +121,7 @@ class TestTpAcceptance:
     always runs them."""
 
     @pytest.mark.parametrize("kv_bits", [0, 8])
+    @pytest.mark.slow
     def test_dp2_mp2_streams_exact_one_trace(self, kv_bits):
         srv = _run_parity({"data": 2, "model": 2}, kv_bits)
         # per-chip KV pool bytes: measured (sharded device arrays /
