@@ -808,12 +808,18 @@ def multi_tenant_replay_bench(slots: int = 4, new: int = 16,
     Reports per-tenant p50/p99 TTFT and inter-token latency, shed /
     timeout rates, and the draft acceptance rate: the fairness
     instrument — under the bursts the interactive percentiles should
-    hold while the batch tenant absorbs the queueing and the sheds."""
+    hold while the batch tenant absorbs the queueing and the sheds.
+
+    An ``SloMonitor`` with bench-tight windows rides along: the SLO
+    column reports how many burn-rate alerts fired, the time to the
+    first alert, and the time the running p99 of the under-provisioned
+    tenant's TTFT first showed the breach — the alert should win that
+    race (docs/observability.md "SLO alerting")."""
     import jax
     import jax.numpy as jnp
     import deepspeed_tpu as ds
     from deepspeed_tpu.inference.serving import (ServingFrontend,
-                                                 TenantSpec)
+                                                 SloMonitor, TenantSpec)
     from deepspeed_tpu.models import TransformerLM, gpt2_config
 
     cfg = gpt2_config("125m", dtype=jnp.float32, **model_kw)
@@ -828,19 +834,39 @@ def multi_tenant_replay_bench(slots: int = 4, new: int = 16,
         "125m", dtype=jnp.float32, **dict(model_kw, num_layers=1)))
     srv = eng.serving_engine(draft_model=draft,
                              draft_params=draft.init(jax.random.PRNGKey(1)))
-    fe = ServingFrontend(srv)
+    # bench-tight burn-rate windows so a breach inside a ~seconds run
+    # is observable; threshold 1.0 = burning the error budget at all
+    slo_mon = SloMonitor(objective=0.9, fast_window_s=2.0,
+                         slow_window_s=8.0, burn_threshold=1.0,
+                         min_samples=3)
+    alerts = []
+    slo_mon.subscribe(lambda a: alerts.append(
+        (time.perf_counter(), a)))
+    fe = ServingFrontend(srv, slo=slo_mon)
     fe.register(TenantSpec("interactive", weight=4.0, ttft_slo_s=0.5))
     fe.register(TenantSpec("standard", weight=1.0))
-    fe.register(TenantSpec("batch", weight=1.0, max_queue_share=0.5))
+    # the under-provisioned tenant: unit weight, a TTFT target its own
+    # bursts cannot meet behind the bounded queue — the burn-rate alert
+    # should fire here, and before the p99 shows it
+    fe.register(TenantSpec("batch", weight=1.0, max_queue_share=0.5,
+                           ttft_slo_s=0.3))
     tenants = ("interactive", "standard", "batch")
     ttft = {t: [] for t in tenants}
     itl = {t: [] for t in tenants}
+    p99_breach = {"at": None}
 
     def hook(ev):
         if ev.token is None or ev.tenant not in ttft:
             return
         if ev.index == 0:
             ttft[ev.tenant].append(ev.time_s - ev.request.submit_time)
+            # the histogram's view of the breach: first wall time the
+            # running p99 of the batch tenant's completed TTFTs
+            # exceeds its target
+            if (ev.tenant == "batch" and p99_breach["at"] is None
+                    and len(ttft["batch"]) >= 3
+                    and float(np.percentile(ttft["batch"], 99)) > 0.3):
+                p99_breach["at"] = time.perf_counter()
         elif ev.prev_time_s is not None:
             itl[ev.tenant].append(ev.time_s - ev.prev_time_s)
 
@@ -868,6 +894,15 @@ def multi_tenant_replay_bench(slots: int = 4, new: int = 16,
         srv.step()
     srv.run()
     dt = time.perf_counter() - t0
+    # quiet tail: the load is gone, the fast window drains, and the
+    # firing alerts must RESOLVE (the hysteresis edge of the state
+    # machine) — bounded at ~2.5x the fast window
+    quiet_deadline = time.perf_counter() + 2.5 * slo_mon.fast_window_s
+    while (any(v["state"] == "firing"
+               for v in slo_mon.snapshot().values())
+           and time.perf_counter() < quiet_deadline):
+        time.sleep(0.1)
+        slo_mon.evaluate()
 
     def pcts(xs):
         if not xs:
@@ -887,6 +922,10 @@ def multi_tenant_replay_bench(slots: int = 4, new: int = 16,
             "shed_rate": round(shed / max(len(rs_t), 1), 3),
             "timeout_rate": round(timed / max(len(rs_t), 1), 3),
             "tokens": sum(len(r.output) for r in rs_t)}
+    fired = [(at, a) for at, a in alerts if a.state == "firing"]
+    first_alert_s = round(fired[0][0] - t0, 3) if fired else None
+    breach_s = round(p99_breach["at"] - t0, 3) \
+        if p99_breach["at"] is not None else None
     print(json.dumps({
         "metric": "multi_tenant_replay",
         "value": round(sum(pt["tokens"] for pt in per_tenant.values())
@@ -896,6 +935,18 @@ def multi_tenant_replay_bench(slots: int = 4, new: int = 16,
         "spec_proposed": sc["proposed"], "spec_accepted": sc["accepted"],
         "spec_acceptance_rate": round(
             sc["accepted"] / max(sc["proposed"], 1), 3),
+        "slo": {
+            "alerts_fired": len(fired),
+            "alerts_resolved": sum(
+                a.state == "resolved" for _, a in alerts),
+            "time_to_first_alert_s": first_alert_s,
+            "p99_breach_at_s": breach_s,
+            "alert_before_p99": (first_alert_s is not None
+                                 and (breach_s is None
+                                      or first_alert_s <= breach_s)),
+            "firing_now": sorted(
+                k for k, v in slo_mon.snapshot().items()
+                if v["state"] == "firing")},
         "decode_builds": srv.decode_builds}), flush=True)
 
 

@@ -15,6 +15,7 @@ Three layers, composed by ``InferenceEngine.serving_engine()``:
     (:class:`ServingFrontend`): weighted-fair admission / prefill /
     shed policies plus per-tenant metrics.
 """
+from ...observability.slo import SloAlert, SloMonitor  # noqa: F401
 from ...runtime.resilience.errors import ServingError  # noqa: F401
 from .block_allocator import (BlockPoolError, NULL_BLOCK,  # noqa: F401
                               PagedBlockAllocator, blocks_for_budget,
@@ -28,6 +29,7 @@ from .scheduler import (ContinuousBatchingScheduler, Request,  # noqa: F401
 __all__ = ["BlockPoolError", "NULL_BLOCK", "PagedBlockAllocator",
            "ContinuousBatchingScheduler", "Request", "RequestState",
            "RequestStatus", "ServingEngine", "ServingError",
-           "ServingFrontend", "StreamCollector", "TokenEvent",
+           "ServingFrontend", "SloAlert", "SloMonitor",
+           "StreamCollector", "TokenEvent",
            "TenantRegistry", "TenantSpec",
            "kv_block_bytes", "blocks_for_budget"]

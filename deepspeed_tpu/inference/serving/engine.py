@@ -50,7 +50,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ...observability import get_registry, trace_span
+from ...observability import (get_flight_recorder, get_registry,
+                              get_request_tracer, trace_span)
 from ...parallel import topology as topo
 from ...parallel.shard_map_compat import shard_map
 from ...runtime.resilience.errors import (FatalIOError, ServingError,
@@ -147,6 +148,11 @@ class ServingEngine:
         self.kv_bits = cfg.kv_cache_bits
         #: consecutive zero-progress iterations (the serving watchdog)
         self._no_progress = 0
+        # request-trace recorder + flight recorder (observability/):
+        # process-global singletons; every hot-path site below guards on
+        # ``.enabled`` so the disabled default is one attribute check
+        self._rt = get_request_tracer()
+        self._fr = get_flight_recorder()
         # -- (data, model) serving submesh (docs/serving.md
         # "Tensor-parallel serving"): model shards heads + KV pool +
         # MLP, data shards the decode slots; 1x1 keeps the legacy
@@ -880,6 +886,8 @@ class ServingEngine:
         msg = (f"non-finite logits at {where} (slot {slot}) after "
                f"{len(req.output)} tokens — request quarantined, KV "
                f"blocks discarded")
+        if self._rt.enabled:
+            self._rt.mark(req, "quarantine", where=where, slot=slot)
         with trace_span("serving/quarantine", req=req.req_id, slot=slot):
             self.scheduler.terminate_slot(slot, RequestStatus.FAILED,
                                           msg, discard=True)
@@ -1014,6 +1022,11 @@ class ServingEngine:
         # bookkeeping below (commit hashing, finishes, quarantines) so
         # the histogram stays comparable across PRs
         dispatch_dt = time.perf_counter() - t0
+        if self._rt.enabled and dec:
+            # request-track segments reuse t0/dispatch_dt — no extra
+            # clock reads on the hot path
+            self._rt.on_decode([r for _, r in dec], t0, dispatch_dt,
+                               len(dec))
         progress = 0
         for slot, req in dec:
             if not bool(dec_fin[slot]):
@@ -1053,6 +1066,9 @@ class ServingEngine:
             old = req.cached_tokens
             req.cached_tokens += appended
             progress += appended
+            if self._rt.enabled:
+                self._rt.on_spec([req], t0, dispatch_dt, self.spec_k,
+                                 max(0, appended - 1))
             self.spec_counts["proposed"] += self.spec_k
             self._m_spec_proposed.inc(self.spec_k)
             if appended > 1:
@@ -1065,7 +1081,11 @@ class ServingEngine:
             if req.done:
                 sched.finish(slot)
         if dec or spec:
-            self._m_itl.observe(dispatch_dt)
+            # exemplar: any batch participant experienced this dispatch
+            # latency; None while request tracing is off (no-op)
+            self._m_itl.observe(dispatch_dt,
+                                exemplar=(dec[0][1].trace_id if dec
+                                          else spec[0][1].trace_id))
             if progress:
                 self._m_tokens.inc(progress)
         if chunk is not None:
@@ -1078,6 +1098,10 @@ class ServingEngine:
                 self._m_prefill_tokens.inc(c_len)
                 self.allocator.commit_cached(req.req_id, req.prefix,
                                              req.cached_tokens)
+                if self._rt.enabled:
+                    self._rt.on_prefill_chunk(
+                        req, t0, dispatch_dt, c_start, c_len,
+                        done=req.cached_tokens >= req.prefill_target)
                 if req.cached_tokens >= req.prefill_target:
                     # the chunk that completed the prefix carries the
                     # first token (sampled from its last valid position
@@ -1090,7 +1114,8 @@ class ServingEngine:
                     if req.first_token_time is None:
                         req.first_token_time = time.perf_counter()
                         self._m_ttft.observe(
-                            req.first_token_time - req.submit_time)
+                            req.first_token_time - req.submit_time,
+                            exemplar=req.trace_id)
                     if req.done:
                         sched.finish(chunk[0])
         return progress
@@ -1109,6 +1134,19 @@ class ServingEngine:
         scheduler diagnostics after ``serving.no_progress_steps``
         consecutive iterations that moved nothing (no tokens, no prefill
         chunks, no terminal transitions) while work remained."""
+        try:
+            return self._step_impl()
+        except ServingError as e:
+            # black-box flight recorder: seal the post-mortem bundle
+            # (snapshot ring + terminals + metrics + trace) before the
+            # error propagates — dump() never raises and never masks
+            # the original failure
+            if self._fr.enabled:
+                self._fr.dump("serving_error", str(e), extra={
+                    "diagnose": self._diagnose("engine state at failure")})
+            raise
+
+    def _step_impl(self) -> bool:
         sched = self.scheduler
         finished_before = len(sched.finished)
         sched.sweep_deadlines()
@@ -1174,6 +1212,9 @@ class ServingEngine:
         # and every terminal transition reaches its stream callbacks
         # here, on the serving thread, in emission order
         self._flush_events()
+        if self._fr.enabled:
+            # all plain host-side ints — no device interaction
+            self._fr.record(self._flight_snapshot())
         # terminal transitions count as progress: a sweep that expires
         # requests, a quarantine, or a thrash-fail all MOVED state.
         # Preemptions deliberately do not — a preemption-only iteration
@@ -1191,6 +1232,26 @@ class ServingEngine:
                     f"zero terminal transitions) — scheduler wedged or "
                     f"every dispatch faulted"))
         return sched.has_work
+
+    def _flight_snapshot(self) -> dict:
+        """One flight-recorder frame: the engine state an operator needs
+        to reconstruct the final iterations after a crash."""
+        sched, alloc = self.scheduler, self.allocator
+        return {
+            "t": time.perf_counter(),
+            "queue_depth": sched.queue_depth,
+            "active_slots": sched.active_slots,
+            "pool_used": alloc.num_used,
+            "pool_free": alloc.num_free,
+            "pool_cached": alloc.num_cached,
+            "preemptions": sched.preemption_count,
+            "pinned": sum(1 for r in sched.running.values()
+                          if sched.pinned(r)),
+            "no_progress": self._no_progress,
+            "lifecycle": dict(self.lifecycle_counts),
+            "spec": dict(self.spec_counts),
+            "decode_builds": self.decode_builds,
+        }
 
     def _diagnose(self, headline: str) -> str:
         """Scheduler + pool state snapshot for loud errors (watchdog,
@@ -1263,8 +1324,11 @@ class ServingEngine:
         while self.step():
             steps += 1
             if steps >= max_steps:
-                raise ServingError(self._diagnose(
-                    f"serving did not drain within {max_steps} steps"))
+                msg = self._diagnose(
+                    f"serving did not drain within {max_steps} steps")
+                if self._fr.enabled:
+                    self._fr.dump("serving_error", msg)
+                raise ServingError(msg)
         # a drained pool must hold zero sequence-referenced blocks
         # (cached-LRU blocks may remain — they are reclaimable capacity,
         # not leaks) — leak check

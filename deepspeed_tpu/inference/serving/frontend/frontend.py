@@ -31,7 +31,9 @@ import time
 from typing import Deque, Dict, List, Optional, Tuple
 
 from ....observability import get_registry
-from ....observability.metrics import sanitize_name
+from ....observability.metrics import tenant_metric_name
+from ....observability.slo import KIND_ITL, KIND_TTFT, SloMonitor
+from ....observability.slo import from_defaults as _slo_from_defaults
 from ..scheduler import Request, RequestStatus
 from .tenancy import TenantRegistry, TenantSpec
 
@@ -53,11 +55,17 @@ class ServingFrontend:
     """
 
     def __init__(self, srv,
-                 registry: Optional[TenantRegistry] = None) -> None:
+                 registry: Optional[TenantRegistry] = None,
+                 slo: object = "auto") -> None:
         self.srv = srv
         self.tenants = registry if registry is not None \
             else TenantRegistry()
         self._metrics: Dict[str, Dict[str, object]] = {}
+        #: per-tenant SLO burn-rate monitor (observability/slo.py):
+        #: "auto" builds from the observability config's ``slo`` block
+        #: (None when the block is off), or pass an SloMonitor / None
+        self.slo: Optional[SloMonitor] = \
+            _slo_from_defaults() if slo == "auto" else slo
         srv.scheduler.admission_policy = self._order_admissions
         srv.scheduler.prefill_policy = self._order_prefills
         srv.scheduler.shed_policy = self._pick_shed_victim
@@ -86,13 +94,20 @@ class ServingFrontend:
     # -- scheduler policies ------------------------------------------------
     def _order_admissions(self, waiting: Deque[Request]) -> None:
         now = time.perf_counter()
+        slo = self.slo
 
         def key(req: Request):
             spec = self.tenants.get(req.tenant)
+            # a firing TTFT burn-rate alert marks the WHOLE tenant
+            # at-risk: its error budget is already burning faster than
+            # sustainable, so every queued request boosts within the
+            # tier, not just the ones individually near the deadline
             at_risk = int(
-                spec.ttft_slo_s > 0
-                and now - req.submit_time
-                > TTFT_RISK_FRACTION * spec.ttft_slo_s)
+                (spec.ttft_slo_s > 0
+                 and now - req.submit_time
+                 > TTFT_RISK_FRACTION * spec.ttft_slo_s)
+                or (slo is not None
+                    and slo.firing(req.tenant, KIND_TTFT)))
             return (-spec.priority, -at_risk,
                     self.tenants.vtc.get(req.tenant, 0.0),
                     req.submit_time)
@@ -129,12 +144,25 @@ class ServingFrontend:
                                   if incoming.tenant not in counts
                                   else [])
         total = len(waiting)
-        worst, worst_over = None, 0.0
+        slo = self.slo
+        over_cap: List[Tuple[float, str]] = []
         for t, n in counts.items():
             spec = self.tenants.get(t)
             cap = spec.max_queue_share or \
                 self.tenants.fair_share(t, among=present)
             over = n / total - cap
+            if over > 0.0:
+                over_cap.append((over, t))
+        # a tenant with a firing SLO alert is already losing — don't
+        # pile shedding on top of it when another over-cap tenant can
+        # absorb the overload instead (all-firing falls through)
+        if slo is not None and over_cap:
+            calm = [(o, t) for o, t in over_cap
+                    if not slo.firing_any(t)]
+            if calm:
+                over_cap = calm
+        worst, worst_over = None, 0.0
+        for over, t in over_cap:
             if over > worst_over:
                 worst, worst_over = t, over
         if worst is None or worst == incoming.tenant:
@@ -151,19 +179,19 @@ class ServingFrontend:
     def _tenant_metrics(self, name: str) -> Dict[str, object]:
         tm = self._metrics.get(name)
         if tm is None:
-            reg, s = get_registry(), sanitize_name(name)
+            # tenant names are caller-supplied: tenant_metric_name
+            # sanitizes AND disambiguates (crc suffix) so two hostile
+            # names can't collide into one series or smuggle newlines
+            # into the Prometheus textfile
+            reg = get_registry()
+            base = tenant_metric_name("dstpu_serving_tenant", name)
             tm = {
-                "tokens": reg.counter(
-                    f"dstpu_serving_tenant_{s}_tokens_total"),
-                "ttft": reg.histogram(
-                    f"dstpu_serving_tenant_{s}_ttft_seconds"),
-                "itl": reg.histogram(
-                    f"dstpu_serving_tenant_{s}_inter_token_seconds"),
-                "shed": reg.counter(
-                    f"dstpu_serving_tenant_{s}_shed_total"),
-                "timed_out": reg.counter(
-                    f"dstpu_serving_tenant_{s}_timed_out_total"),
-                "vtc": reg.gauge(f"dstpu_serving_tenant_{s}_vtc"),
+                "tokens": reg.counter(f"{base}_tokens_total"),
+                "ttft": reg.histogram(f"{base}_ttft_seconds"),
+                "itl": reg.histogram(f"{base}_inter_token_seconds"),
+                "shed": reg.counter(f"{base}_shed_total"),
+                "timed_out": reg.counter(f"{base}_timed_out_total"),
+                "vtc": reg.gauge(f"{base}_vtc"),
             }
             self._metrics[name] = tm
         return tm
@@ -178,10 +206,20 @@ class ServingFrontend:
         self.tenants.charge(ev.tenant, cost)
         tm["vtc"].set(self.tenants.vtc[ev.tenant])
         tm["tokens"].inc()
+        exemplar = getattr(ev.request, "trace_id", None)
+        spec = self.tenants.get(ev.tenant)
+        slo = self.slo
         if ev.index == 0:
-            tm["ttft"].observe(ev.time_s - ev.request.submit_time)
+            ttft = ev.time_s - ev.request.submit_time
+            tm["ttft"].observe(ttft, exemplar=exemplar)
+            if slo is not None:
+                slo.observe(ev.tenant, KIND_TTFT, ttft,
+                            spec.ttft_slo_s)
         elif ev.prev_time_s is not None:
-            tm["itl"].observe(ev.time_s - ev.prev_time_s)
+            itl = ev.time_s - ev.prev_time_s
+            tm["itl"].observe(itl, exemplar=exemplar)
+            if slo is not None:
+                slo.observe(ev.tenant, KIND_ITL, itl, spec.itl_slo_s)
 
     def _on_terminal(self, req: Request) -> None:
         tm = self._tenant_metrics(req.tenant)

@@ -45,6 +45,10 @@ class TenantSpec:
     max_queue_share: float = 0.0
 
     def __post_init__(self) -> None:
+        if not isinstance(self.name, str) or not self.name:
+            raise ValueError(
+                f"tenant name must be a non-empty string, got "
+                f"{self.name!r}")
         if self.weight <= 0:
             raise ValueError(
                 f"tenant {self.name!r}: weight must be > 0, got "

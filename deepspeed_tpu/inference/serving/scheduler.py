@@ -74,9 +74,17 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
+from ...observability.flight_recorder import get_flight_recorder
+from ...observability.request_trace import get_request_tracer
 from ...runtime.resilience.errors import FatalIOError, TransientIOError
 from ...runtime.resilience.fault_injection import get_fault_injector
 from .block_allocator import BlockPoolError, PagedBlockAllocator
+
+# process-global recorders (observability/) — every call site below
+# guards on ``.enabled``, so the disabled default stays one attribute
+# check per lifecycle event with no allocation or clock read
+_REQ_TRACE = get_request_tracer()
+_FLIGHT = get_flight_recorder()
 
 
 class RequestState(enum.Enum):
@@ -150,6 +158,10 @@ class Request:
     #: wall time of the most recently streamed token (per-tenant
     #: inter-token latency accounting)
     last_token_time: Optional[float] = None
+    #: request-scoped trace id (observability/request_trace.py) —
+    #: assigned at submit when request tracing is enabled, doubles as
+    #: the TTFT/ITL histogram exemplar; None while tracing is off
+    trace_id: Optional[str] = None
 
     @property
     def prefix(self) -> List[int]:
@@ -262,6 +274,8 @@ class ContinuousBatchingScheduler:
                 f"may hold at most "
                 f"{min(self.max_blocks_per_seq, self.alloc.usable_blocks)}"
                 f" — raise serving.num_kv_blocks / max_out_tokens")
+        if _REQ_TRACE.enabled:
+            _REQ_TRACE.on_submit(req)
         if self.max_queue_depth and \
                 len(self.waiting) >= self.max_queue_depth:
             victim = None
@@ -300,6 +314,16 @@ class ContinuousBatchingScheduler:
         self.finished.append(req)
         if status is not RequestStatus.OK:
             self.terminal_events.append(req)
+        if _REQ_TRACE.enabled:
+            _REQ_TRACE.on_terminal(req)
+        if _FLIGHT.enabled:
+            _FLIGHT.note_terminal({
+                "req_id": req.req_id, "trace_id": req.trace_id,
+                "tenant": req.tenant,
+                "status": req.status.name if req.status else None,
+                "error": req.error, "tokens": len(req.output),
+                "preemptions": req.preemptions,
+                "finish_time": req.finish_time})
         return req
 
     def terminate_slot(self, slot: int, status: RequestStatus,
@@ -415,6 +439,8 @@ class ContinuousBatchingScheduler:
             self.running[slot] = req
             self._admit_order.append(slot)
             admitted.append((slot, req))
+            if _REQ_TRACE.enabled:
+                _REQ_TRACE.on_admit(req, slot, cached)
         return admitted
 
     def next_prefill_chunk(self, budget: int
@@ -564,6 +590,8 @@ class ContinuousBatchingScheduler:
         req.prefill_target = 0
         req.preemptions += 1
         self.preemption_count += 1
+        if _REQ_TRACE.enabled:
+            _REQ_TRACE.on_preempt(req)
         # front of the queue, so the original admission order is preserved
         self.waiting.appendleft(req)
 

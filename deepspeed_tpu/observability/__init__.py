@@ -27,8 +27,13 @@ import atexit
 import os
 from typing import Any, List, Optional, Tuple
 
+from .flight_recorder import FlightRecorder, get_flight_recorder  # noqa: F401
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
-                      sanitize_name)
+                      sanitize_name, tenant_metric_name)
+from .request_trace import (RequestTraceRecorder,  # noqa: F401
+                            get_request_tracer)
+from .slo import SloAlert, SloMonitor  # noqa: F401
+from .slo import from_defaults as slo_from_defaults  # noqa: F401
 from .tracer import NULL_SPAN, SpanTracer  # noqa: F401
 
 _tracer = SpanTracer()
@@ -99,9 +104,15 @@ def configure(obs_config: Any = None, rank: int = 0
     process-global tracer/registry. Idempotent; the newest engine wins —
     telemetry is per-process, not per-engine."""
     global _atexit_armed
+    from . import slo as _slo_mod
+    _rt = get_request_tracer()
+    _fr = get_flight_recorder()
     if obs_config is None:
         _tracer.configure(enabled=False)
         _registry.enabled = False
+        _rt.configure(enabled=False)
+        _fr.configure(enabled=False)
+        _slo_mod.set_defaults(enabled=False)
         return _tracer, _registry
     tr = obs_config.tracing
     mt = obs_config.metrics
@@ -113,6 +124,42 @@ def configure(obs_config: Any = None, rank: int = 0
     _export["interval_steps"] = int(mt.export_interval_steps or 0)
     if mt.enabled:
         _register_core_metrics()
+    # request-scoped tracing: rides the span tracer's flush as an extra
+    # per-request track source (config validation already requires
+    # tracing.enabled when request_tracing.enabled)
+    rt_cfg = getattr(obs_config, "request_tracing", None)
+    rt_enabled = bool(rt_cfg is not None and rt_cfg.enabled)
+    _rt.configure(enabled=rt_enabled,
+                  capacity=rt_cfg.capacity if rt_cfg else None,
+                  max_segments=rt_cfg.max_segments if rt_cfg else None,
+                  rank=rank)
+    _tracer.set_event_source(
+        "request_trace", _rt.chrome_events if rt_enabled else None)
+    # SLO burn-rate alerting defaults (the serving front-end builds its
+    # monitor from these via slo.from_defaults())
+    slo_cfg = getattr(obs_config, "slo", None)
+    if slo_cfg is not None and slo_cfg.enabled:
+        _slo_mod.set_defaults(
+            enabled=True, objective=slo_cfg.objective,
+            fast_window_s=slo_cfg.fast_window_s,
+            slow_window_s=slo_cfg.slow_window_s,
+            burn_threshold=slo_cfg.burn_threshold,
+            resolve_fraction=slo_cfg.resolve_fraction,
+            min_samples=slo_cfg.min_samples)
+    else:
+        _slo_mod.set_defaults(enabled=False)
+    # flight recorder: bounded snapshot ring + post-mortem bundles
+    fl_cfg = getattr(obs_config, "flight", None)
+    fl_enabled = bool(fl_cfg is not None and fl_cfg.enabled)
+    _fr.configure(enabled=fl_enabled,
+                  capacity=fl_cfg.capacity if fl_cfg else None,
+                  output_dir=fl_cfg.output_dir if fl_cfg else None,
+                  max_terminal_events=(fl_cfg.max_terminal_events
+                                       if fl_cfg else None),
+                  skip_burst_steps=(fl_cfg.skip_burst_steps
+                                    if fl_cfg else None),
+                  max_bundles=fl_cfg.max_bundles if fl_cfg else None,
+                  rank=rank)
     if (tr.enabled or mt.enabled) and not _atexit_armed:
         atexit.register(flush_all)
         _atexit_armed = True

@@ -25,6 +25,7 @@ import json
 import math
 import os
 import threading
+import zlib
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 #: default histogram boundaries — seconds, spanning 100 µs .. 60 s (step
@@ -35,14 +36,35 @@ DEFAULT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
 
 
 def sanitize_name(name: str) -> str:
-    """Map an arbitrary span/op name onto the Prometheus charset."""
+    """Map an arbitrary span/op name onto the Prometheus charset.
+
+    ASCII-strict: ``str.isalnum()`` is true for plenty of characters
+    Prometheus rejects (``é``, ``Ⅻ``, CJK), so anything outside
+    ``[a-zA-Z0-9_]`` becomes ``_``.
+    """
     out = []
     for ch in name:
-        out.append(ch if ch.isalnum() or ch == "_" else "_")
+        out.append(ch if (ch.isascii() and ch.isalnum()) or ch == "_"
+                   else "_")
     s = "".join(out)
     if s and s[0].isdigit():
         s = "_" + s
     return s
+
+
+def tenant_metric_name(prefix: str, tenant: str, *parts: str) -> str:
+    """Build a dynamic per-tenant series name that is always a valid
+    Prometheus identifier AND collision-free.
+
+    Escaping alone is not enough: two hostile tenant ids (``"a b"`` and
+    ``"a.b"``) would both sanitize to ``a_b`` and silently merge their
+    series — so whenever sanitization had to change the name (or it was
+    empty), a short stable checksum of the *original* id is appended.
+    """
+    s = sanitize_name(tenant)
+    if s != tenant or not s:
+        s = f"{s}_{zlib.crc32(tenant.encode('utf-8', 'surrogatepass')) & 0xffff:04x}"
+    return "_".join((prefix, s) + parts)
 
 
 class Counter:
@@ -90,10 +112,17 @@ class Gauge:
 
 
 class Histogram:
-    """Cumulative-bucket histogram (Prometheus semantics)."""
+    """Cumulative-bucket histogram (Prometheus semantics).
+
+    Observations may carry an **exemplar** — a trace id linking the
+    bucket back to the concrete request that landed in it (OpenMetrics
+    exemplar semantics: the newest exemplar per bucket wins). Exemplar
+    storage is lazily allocated on the first exemplar-carrying
+    observation, so histograms without request tracing pay nothing.
+    """
     kind = "histogram"
     __slots__ = ("name", "help", "buckets", "_lock", "_counts", "_sum",
-                 "_count")
+                 "_count", "_exemplars")
 
     def __init__(self, name: str, help: str = "",
                  buckets: Optional[Sequence[float]] = None):
@@ -104,13 +133,49 @@ class Histogram:
         self._counts = [0] * (len(self.buckets) + 1)   # +inf tail
         self._sum = 0.0
         self._count = 0
+        self._exemplars: Optional[List[Optional[Tuple[str, float]]]] = None
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         i = bisect.bisect_left(self.buckets, v)
         with self._lock:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if exemplar is not None:
+                if self._exemplars is None:
+                    self._exemplars = [None] * len(self._counts)
+                self._exemplars[i] = (exemplar, v)
+
+    def exemplars(self) -> Dict[int, Tuple[str, float]]:
+        """{bucket_index: (trace_id, value)} for buckets holding one."""
+        with self._lock:
+            if self._exemplars is None:
+                return {}
+            return {i: ex for i, ex in enumerate(self._exemplars)
+                    if ex is not None}
+
+    def quantile(self, q: float) -> float:
+        """Estimate the q-quantile by linear interpolation inside the
+        bucket bounds (the `histogram_quantile()` estimator): the +inf
+        bucket clamps to the highest finite bound, matching Prometheus."""
+        if not (0.0 <= q <= 1.0):
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+        if total == 0:
+            return 0.0
+        target = q * total
+        acc = 0
+        for i, c in enumerate(counts):
+            if acc + c >= target and c > 0:
+                if i >= len(self.buckets):          # +inf bucket
+                    return float(self.buckets[-1])
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i]
+                return lo + (hi - lo) * (target - acc) / c
+            acc += c
+        return float(self.buckets[-1])
 
     @property
     def count(self) -> int:
@@ -221,8 +286,19 @@ class MetricsRegistry:
             if m.kind == "histogram":
                 out[name] = {"kind": m.kind, "sum": m.sum,
                              "count": m.count, "mean": m.value,
+                             "p50": m.quantile(0.50),
+                             "p95": m.quantile(0.95),
+                             "p99": m.quantile(0.99),
                              "buckets": [[le if le != math.inf else "+Inf",
                                           c] for le, c in m.cumulative()]}
+                ex = m.exemplars()
+                if ex:
+                    bounds = m.buckets
+                    out[name]["exemplars"] = {
+                        ("+Inf" if i >= len(bounds)
+                         else repr(float(bounds[i]))): {
+                            "trace_id": tid, "value": v}
+                        for i, (tid, v) in sorted(ex.items())}
             else:
                 out[name] = {"kind": m.kind, "value": m.value}
         return out
@@ -245,11 +321,23 @@ class MetricsRegistry:
                 lines.append(f"# HELP {name} {m.help}")
             lines.append(f"# TYPE {name} {m.kind}")
             if m.kind == "histogram":
-                for le, c in m.cumulative():
+                ex = m.exemplars()
+                for i, (le, c) in enumerate(m.cumulative()):
                     le_s = "+Inf" if le == math.inf else repr(float(le))
-                    lines.append(f'{name}_bucket{{le="{le_s}"}} {c}')
+                    line = f'{name}_bucket{{le="{le_s}"}} {c}'
+                    if i in ex:
+                        # OpenMetrics exemplar: link the bucket to the
+                        # request trace that landed in it most recently
+                        tid, v = ex[i]
+                        line += f' # {{trace_id="{tid}"}} {v!r}'
+                    lines.append(line)
                 lines.append(f"{name}_sum {m.sum!r}")
                 lines.append(f"{name}_count {m.count}")
+                # estimated quantiles (interpolated inside the bucket
+                # bounds) as companion gauges — dashboards get p50/p95/
+                # p99 without a histogram_quantile() recording rule
+                for q, tag in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+                    lines.append(f"{name}_{tag} {m.quantile(q)!r}")
             else:
                 lines.append(f"{name} {m.value!r}")
         return "\n".join(lines) + "\n"

@@ -106,6 +106,11 @@ class SpanTracer:
         self._epoch_ns = time.perf_counter_ns()
         self.rank = 0
         self.output_dir = "traces"
+        # extra flush-time event providers (the request-trace recorder
+        # merges its per-request waterfall tracks here) — keyed so
+        # re-configuration doesn't stack duplicates. Each provider is
+        # called as fn(epoch_ns, rank) -> [chrome events].
+        self._event_sources: Dict[str, Any] = {}
 
     # -- configuration -----------------------------------------------------
     def configure(self, enabled: bool, capacity: Optional[int] = None,
@@ -172,6 +177,20 @@ class SpanTracer:
         with self._lock:
             self._n = 0
 
+    # -- event sources -----------------------------------------------------
+    def set_event_source(self, key: str, fn: Any) -> None:
+        """Register (or replace) a flush-time event provider; pass
+        ``None`` to remove it."""
+        with self._lock:
+            if fn is None:
+                self._event_sources.pop(key, None)
+            else:
+                self._event_sources[key] = fn
+
+    @property
+    def epoch_ns(self) -> int:
+        return self._epoch_ns
+
     # -- export ------------------------------------------------------------
     def _events(self) -> List[Dict[str, Any]]:
         """Trace events, oldest first, under the lock (consistent cut even
@@ -202,7 +221,15 @@ class SpanTracer:
         for tid, tname in sorted(threads.items()):
             meta.append({"ph": "M", "pid": self.rank, "tid": tid,
                          "name": "thread_name", "args": {"name": tname}})
-        return meta + out
+        with self._lock:
+            sources = list(self._event_sources.values())
+        extra: List[Dict[str, Any]] = []
+        for fn in sources:
+            try:
+                extra.extend(fn(self._epoch_ns, self.rank))
+            except Exception:    # a broken source must not kill the flush
+                pass
+        return meta + out + extra
 
     def flush(self, path: Optional[str] = None, sync: Any = None) -> str:
         """Serialize the ring to Chrome trace-event JSON.

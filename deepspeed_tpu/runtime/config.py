@@ -431,15 +431,123 @@ class ObsMetricsConfig(ConfigModel):
         return self
 
 
+class RequestTracingConfig(ConfigModel):
+    """``observability.request_tracing`` — per-request serving timelines
+    (deepspeed_tpu/observability/request_trace.py). Every request gets a
+    trace id at submit; lifecycle sites stamp segments that export as a
+    Perfetto waterfall track per request inside the span tracer's
+    ``trace_rank<r>.json``. Requires ``tracing.enabled`` (the export
+    rides the same flush)."""
+    enabled: bool = C.OBSERVABILITY_REQUEST_TRACE_ENABLED_DEFAULT
+    # retained request timelines; oldest completed evicted first
+    capacity: int = C.OBSERVABILITY_REQUEST_TRACE_CAPACITY_DEFAULT
+    # stamped segments per request before drops are counted
+    max_segments: int = C.OBSERVABILITY_REQUEST_TRACE_SEGMENTS_DEFAULT
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.capacity < 1 or self.max_segments < 1:
+            raise ValueError(
+                "observability.request_tracing: capacity and max_segments "
+                f"must be >= 1, got {self.capacity}/{self.max_segments}")
+        return self
+
+
+class SloConfig(ConfigModel):
+    """``observability.slo`` — per-tenant multi-window burn-rate alerting
+    over the TTFT / inter-token SLOs declared in ``TenantSpec``
+    (deepspeed_tpu/observability/slo.py). An alert fires when the error
+    budget (``1 - objective``) burns ``burn_threshold``x faster than
+    sustainable in BOTH the fast and slow windows."""
+    enabled: bool = C.OBSERVABILITY_SLO_ENABLED_DEFAULT
+    objective: float = C.OBSERVABILITY_SLO_OBJECTIVE_DEFAULT
+    fast_window_s: float = C.OBSERVABILITY_SLO_FAST_WINDOW_DEFAULT
+    slow_window_s: float = C.OBSERVABILITY_SLO_SLOW_WINDOW_DEFAULT
+    burn_threshold: float = C.OBSERVABILITY_SLO_BURN_THRESHOLD_DEFAULT
+    # firing -> resolved once fast burn < threshold * resolve_fraction
+    resolve_fraction: float = C.OBSERVABILITY_SLO_RESOLVE_FRACTION_DEFAULT
+    # fast-window observations required before an alert may fire
+    min_samples: int = C.OBSERVABILITY_SLO_MIN_SAMPLES_DEFAULT
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError(
+                f"observability.slo.objective must be in (0, 1), got "
+                f"{self.objective}")
+        if self.fast_window_s <= 0 or \
+                self.slow_window_s < self.fast_window_s:
+            raise ValueError(
+                "observability.slo: need 0 < fast_window_s <= "
+                f"slow_window_s, got {self.fast_window_s}/"
+                f"{self.slow_window_s}")
+        if self.burn_threshold <= 0 or self.min_samples < 1:
+            raise ValueError(
+                "observability.slo: burn_threshold must be > 0 and "
+                f"min_samples >= 1, got {self.burn_threshold}/"
+                f"{self.min_samples}")
+        if not 0.0 <= self.resolve_fraction <= 1.0:
+            raise ValueError(
+                f"observability.slo.resolve_fraction must be in [0, 1], "
+                f"got {self.resolve_fraction}")
+        return self
+
+
+class FlightRecorderConfig(ConfigModel):
+    """``observability.flight`` — black-box flight recorder
+    (deepspeed_tpu/observability/flight_recorder.py): a bounded ring of
+    per-iteration engine snapshots dumped as an atomic, manifest-sealed
+    post-mortem bundle on ServingError / watchdog trip / skipped-step
+    burst."""
+    enabled: bool = C.OBSERVABILITY_FLIGHT_ENABLED_DEFAULT
+    capacity: int = C.OBSERVABILITY_FLIGHT_CAPACITY_DEFAULT
+    output_dir: str = C.OBSERVABILITY_FLIGHT_DIR_DEFAULT
+    max_terminal_events: int = C.OBSERVABILITY_FLIGHT_TERMINALS_DEFAULT
+    # consecutive skipped train steps that trip a post-mortem dump
+    skip_burst_steps: int = C.OBSERVABILITY_FLIGHT_SKIP_BURST_DEFAULT
+    max_bundles: int = C.OBSERVABILITY_FLIGHT_MAX_BUNDLES_DEFAULT
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.capacity < 1 or self.max_terminal_events < 1 \
+                or self.max_bundles < 1:
+            raise ValueError(
+                "observability.flight: capacity, max_terminal_events and "
+                "max_bundles must be >= 1, got "
+                f"{self.capacity}/{self.max_terminal_events}/"
+                f"{self.max_bundles}")
+        if self.skip_burst_steps < 1:
+            raise ValueError(
+                f"observability.flight.skip_burst_steps must be >= 1, got "
+                f"{self.skip_burst_steps}")
+        return self
+
+
 class ObservabilityConfig(ConfigModel):
     """``observability`` block (deepspeed_tpu/observability/,
     docs/observability.md)."""
     tracing: TracingConfig = Field(default_factory=TracingConfig)
     metrics: ObsMetricsConfig = Field(default_factory=ObsMetricsConfig)
+    request_tracing: RequestTracingConfig = Field(
+        default_factory=RequestTracingConfig)
+    slo: SloConfig = Field(default_factory=SloConfig)
+    flight: FlightRecorderConfig = Field(
+        default_factory=FlightRecorderConfig)
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.request_tracing.enabled and not self.tracing.enabled:
+            raise ValueError(
+                "observability.request_tracing.enabled requires "
+                "observability.tracing.enabled — the per-request "
+                "waterfall exports inside the span tracer's Chrome trace")
+        return self
 
     @property
     def enabled(self) -> bool:
-        return self.tracing.enabled or self.metrics.enabled
+        return (self.tracing.enabled or self.metrics.enabled
+                or self.request_tracing.enabled or self.slo.enabled
+                or self.flight.enabled)
 
 
 #: remat policies the model's ``_remat`` accepts (models/transformer.py);

@@ -110,6 +110,28 @@ OBSERVABILITY_TRACE_BUFFER_DEFAULT = 65536      # ring capacity, spans
 OBSERVABILITY_TRACE_DIR_DEFAULT = "traces"
 OBSERVABILITY_METRICS_ENABLED_DEFAULT = False
 OBSERVABILITY_EXPORT_INTERVAL_DEFAULT = 0       # steps; 0 = flush-only
+# request-scoped tracing (observability/request_trace.py): per-request
+# serving timelines exported as extra Perfetto tracks in the span trace
+OBSERVABILITY_REQUEST_TRACE_ENABLED_DEFAULT = False
+OBSERVABILITY_REQUEST_TRACE_CAPACITY_DEFAULT = 512   # retained timelines
+OBSERVABILITY_REQUEST_TRACE_SEGMENTS_DEFAULT = 256   # stamps per request
+# SLO burn-rate alerting (observability/slo.py): multi-window burn of
+# each tenant's TTFT / inter-token error budget from TenantSpec
+OBSERVABILITY_SLO_ENABLED_DEFAULT = False
+OBSERVABILITY_SLO_OBJECTIVE_DEFAULT = 0.9       # met-target fraction
+OBSERVABILITY_SLO_FAST_WINDOW_DEFAULT = 30.0    # seconds
+OBSERVABILITY_SLO_SLOW_WINDOW_DEFAULT = 300.0   # seconds
+OBSERVABILITY_SLO_BURN_THRESHOLD_DEFAULT = 2.0  # x budget, both windows
+OBSERVABILITY_SLO_RESOLVE_FRACTION_DEFAULT = 0.5  # hysteresis on resolve
+OBSERVABILITY_SLO_MIN_SAMPLES_DEFAULT = 5       # fast-window floor
+# flight recorder (observability/flight_recorder.py): bounded ring of
+# per-iteration engine snapshots + post-mortem bundles on failure
+OBSERVABILITY_FLIGHT_ENABLED_DEFAULT = False
+OBSERVABILITY_FLIGHT_CAPACITY_DEFAULT = 256     # snapshot ring slots
+OBSERVABILITY_FLIGHT_DIR_DEFAULT = "flight_recorder"
+OBSERVABILITY_FLIGHT_TERMINALS_DEFAULT = 64     # terminal-event ring
+OBSERVABILITY_FLIGHT_SKIP_BURST_DEFAULT = 8     # skipped-step trigger
+OBSERVABILITY_FLIGHT_MAX_BUNDLES_DEFAULT = 4    # bundles kept per rank
 
 # Serving (continuous batching) block defaults — the ``serving`` block
 # of the INFERENCE config (inference/config.py ServingConfig,

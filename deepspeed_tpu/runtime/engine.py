@@ -187,6 +187,9 @@ class DeepSpeedEngine:
         self._analytic_flops_per_step = None
         self._tracer, self._obs = _obs_configure(
             self._config.observability, rank=jax.process_index())
+        from ..observability import get_flight_recorder
+        self._flight = get_flight_recorder()
+        self._skip_burst = 0
         if self._obs.enabled:
             # derived gauges refreshed at export time (plain host reads —
             # memory_stats and the comms log never sync the device)
@@ -1003,7 +1006,8 @@ class DeepSpeedEngine:
         # time async-dispatch enqueue, inflating tok/s and MFU by orders
         # of magnitude
         sync = (self.monitor.enabled or self._config.wall_clock_breakdown
-                or bool(self._config.steps_per_print) or self._obs.enabled)
+                or bool(self._config.steps_per_print) or self._obs.enabled
+                or self._flight.enabled)
         if sync:
             with trace_span("engine/step_sync", step=self.global_steps):
                 self.tput_timer.stop(sync=metrics["loss"])
@@ -1028,10 +1032,34 @@ class DeepSpeedEngine:
         do_print = cfg.steps_per_print and \
             self.global_steps % cfg.steps_per_print == 0
         obs = self._obs
-        if not (do_print or self.monitor.enabled or obs.enabled):
+        fr = self._flight
+        if not (do_print or self.monitor.enabled or obs.enabled
+                or fr.enabled):
             return
         m = {k: float(v) for k, v in metrics.items()}
         step = self.global_steps
+        if fr.enabled:
+            # black-box snapshot per optimizer step; a burst of
+            # consecutive overflow-skipped steps dumps a post-mortem
+            # bundle (the run is diverging or the scale is thrashing —
+            # capture the evidence while the ring still holds it)
+            fr.record({
+                "kind": "train_step", "step": step, "t": time.time(),
+                "loss": m.get("loss"), "grad_norm": m.get("grad_norm"),
+                "loss_scale": m.get("loss_scale"),
+                "overflow": bool(m.get("overflow")),
+            })
+            if m.get("overflow"):
+                self._skip_burst += 1
+                if self._skip_burst >= fr.skip_burst_steps:
+                    fr.dump("skipped_step_burst",
+                            f"{self._skip_burst} consecutive skipped "
+                            f"steps ending at step {step}",
+                            extra={"loss_scale": m.get("loss_scale"),
+                                   "grad_norm": m.get("grad_norm")})
+                    self._skip_burst = 0
+            else:
+                self._skip_burst = 0
         if obs.enabled:
             obs.counter("dstpu_train_steps_total").inc()
             if m.get("overflow"):

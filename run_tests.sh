@@ -180,14 +180,64 @@ if [[ -z "$FILTER" || "chaos" == *"$FILTER"* || "serving" == *"$FILTER"* ]]; the
   )
   for faults in "${CHAOS_MATRIX[@]}"; do
     echo "=== serving-chaos sweep (DSTPU_FAULTS='${faults}')"
+    # the flight-recorder scenario installs its OWN (fatal) injector,
+    # so it runs once in its dedicated stage below, not per matrix entry
     if DSTPU_FAULTS="$faults" JAX_PLATFORMS=cpu python -m pytest \
          tests/unit/test_serving_chaos.py -m chaos -q --tb=short \
-         ${EXTRA_PYTEST_ARGS:-}; then
+         -k "not flight_recorder" ${EXTRA_PYTEST_ARGS:-}; then
       PASSED=$((PASSED + 1))
     else
       FAILED+=("serving-chaos [DSTPU_FAULTS=${faults}]")
     fi
   done
+fi
+
+# Flight-recorder post-mortem stage: replay the chaos fatal-dispatch
+# scenario with the black-box flight recorder + request tracing armed
+# (via DSTPU_FLIGHT_TEST_DIR), then re-open the sealed bundle from a
+# SEPARATE process and verify it parses and its manifest checks out —
+# the operator's recovery path, not just the in-test assertions
+# (docs/observability.md "Flight recorder").
+if [[ -z "$FILTER" || "flight" == *"$FILTER"* || "chaos" == *"$FILTER"* \
+      || "observability" == *"$FILTER"* ]]; then
+  echo "=== flight-recorder post-mortem stage (chaos fatal dispatch)"
+  FLIGHT_DIR=$(mktemp -d)
+  FLIGHT_OK=1
+  DSTPU_FLIGHT_TEST_DIR="$FLIGHT_DIR" JAX_PLATFORMS=cpu python -m pytest \
+       tests/unit/test_serving_chaos.py -q --tb=short \
+       -k flight_recorder ${EXTRA_PYTEST_ARGS:-} || FLIGHT_OK=0
+  if [[ "$FLIGHT_OK" == 1 ]]; then
+    DSTPU_FLIGHT_TEST_DIR="$FLIGHT_DIR" JAX_PLATFORMS=cpu \
+        python - <<'PYEOF' || FLIGHT_OK=0
+import glob, json, os
+from deepspeed_tpu.observability.request_trace import \
+    REQUEST_TRACK_PID_OFFSET
+from deepspeed_tpu.runtime.resilience.integrity import verify_manifest
+root = os.environ["DSTPU_FLIGHT_TEST_DIR"]
+bundles = sorted(glob.glob(os.path.join(root, "postmortem-r*-*")))
+assert bundles, f"no post-mortem bundle under {root}"
+b = bundles[-1]
+ok, problems = verify_manifest(b)
+assert ok, problems
+reason = json.load(open(os.path.join(b, "reason.json")))
+assert reason["reason"] == "serving_error", reason
+snaps = json.load(open(os.path.join(b, "snapshots.json")))
+assert snaps["count"] >= 1, snaps
+json.load(open(os.path.join(b, "terminals.json")))
+assert os.path.getsize(os.path.join(b, "metrics.prom")) > 0
+trace = json.load(open(os.path.join(b, "trace.json")))
+ev = trace["traceEvents"] if isinstance(trace, dict) else trace
+assert any(e.get("pid") == REQUEST_TRACK_PID_OFFSET for e in ev), \
+    "bundled trace has no per-request waterfall tracks"
+print(f"flight-recorder bundle OK: {b} ({snaps['count']} snapshot(s))")
+PYEOF
+  fi
+  rm -rf "$FLIGHT_DIR"
+  if [[ "$FLIGHT_OK" == 1 ]]; then
+    PASSED=$((PASSED + 1))
+  else
+    FAILED+=("flight-recorder post-mortem stage")
+  fi
 fi
 
 echo

@@ -26,7 +26,9 @@ Coverage model:
     tenant, and the shed policy victimizing the queue hog instead of
     the incoming request.
 """
+import re
 import time
+from types import SimpleNamespace
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +41,9 @@ from deepspeed_tpu.inference.serving import (Request, RequestStatus,
                                              StreamCollector,
                                              TenantRegistry, TenantSpec)
 from deepspeed_tpu.models import TransformerLM, gpt2_config
+from deepspeed_tpu.observability.metrics import MetricsRegistry
+from deepspeed_tpu.observability.slo import (KIND_ITL, KIND_TTFT,
+                                             SloMonitor)
 
 pytestmark = [pytest.mark.inference, pytest.mark.frontend]
 
@@ -187,6 +192,7 @@ def test_admission_order_priority_risk_vtc():
     TTFT-at-risk, then smallest virtual counter, then FCFS."""
     from collections import deque
     fe = ServingFrontend.__new__(ServingFrontend)   # policy-only, no engine
+    fe.slo = None
     fe.tenants = TenantRegistry([
         TenantSpec("hog", weight=1.0),
         TenantSpec("fair", weight=1.0),
@@ -269,6 +275,153 @@ def test_shed_policy_victimizes_queue_hog(shared):
     assert all(r.status is RequestStatus.OK
                for r in running + waiting_before[:1])
     assert srv.decode_builds == 1
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate integration (observability/slo.py)
+# ---------------------------------------------------------------------------
+def _policy_frontend(tenants, slo=None):
+    """Policy-only frontend: no engine, just the attrs the scheduler
+    policy hooks and accounting hooks read."""
+    fe = ServingFrontend.__new__(ServingFrontend)
+    fe.tenants = TenantRegistry(tenants)
+    fe.slo = slo
+    fe._metrics = {}
+    return fe
+
+
+def _firing_monitor(tenant, kind=KIND_TTFT):
+    """A real SloMonitor driven into the firing state for ``tenant``."""
+    clock = [100.0]
+    mon = SloMonitor(objective=0.5, fast_window_s=10.0,
+                     slow_window_s=100.0, burn_threshold=1.0,
+                     min_samples=1, registry=MetricsRegistry(),
+                     time_fn=lambda: clock[0])
+    for _ in range(4):
+        mon.observe(tenant, kind, 2.0, 0.5)    # every sample bad
+    assert mon.firing(tenant, kind)
+    return mon
+
+
+def test_firing_slo_alert_boosts_whole_tenant():
+    """A firing TTFT burn-rate alert marks EVERY queued request of the
+    tenant at-risk in admission ordering — not just the ones near their
+    individual deadline."""
+    from collections import deque
+    mon = _firing_monitor("burning")
+    fe = _policy_frontend([TenantSpec("calm"), TenantSpec("burning")],
+                          slo=mon)
+    now = time.perf_counter()
+
+    def mk(tenant, age):
+        r = Request(prompt=[1], max_new_tokens=1, tenant=tenant)
+        r.submit_time = now - age
+        return r
+
+    calm = mk("calm", age=5.0)              # older — FCFS would win
+    burning = mk("burning", age=0.1)        # fresh, no per-req risk
+    q = deque([calm, burning])
+    fe._order_admissions(q)
+    assert list(q) == [burning, calm]
+    # without the monitor, FCFS order holds
+    fe.slo = None
+    q = deque([calm, burning])
+    fe._order_admissions(q)
+    assert list(q) == [calm, burning]
+
+
+def test_shed_policy_spares_firing_tenant():
+    """When two tenants are over their queue-share cap, the one with a
+    firing SLO alert is spared: shedding piles onto a tenant that is
+    already losing.  With every over-cap tenant firing, the policy
+    falls through to normal worst-offender selection."""
+    tenants = [TenantSpec("loud", max_queue_share=0.3),
+               TenantSpec("burning", max_queue_share=0.2),
+               TenantSpec("fresh")]
+
+    def waiting():
+        reqs = []
+        for tenant, n in (("loud", 2), ("burning", 3)):
+            for i in range(n):
+                reqs.append(Request(prompt=[1], max_new_tokens=1,
+                                    tenant=tenant))
+        return reqs
+
+    incoming = Request(prompt=[1], max_new_tokens=1, tenant="fresh")
+    # baseline, no monitor: burning is furthest over cap -> victim
+    fe = _policy_frontend(tenants, slo=None)
+    victim = fe._pick_shed_victim(incoming, waiting())
+    assert victim is not None and victim.tenant == "burning"
+    # burning's alert is firing: loud absorbs the shed instead
+    fe = _policy_frontend(tenants, slo=_firing_monitor("burning"))
+    w = waiting()
+    victim = fe._pick_shed_victim(incoming, w)
+    assert victim is not None and victim.tenant == "loud"
+    assert victim is w[1], "newest waiting request of the victim tenant"
+    # ALL over-cap tenants firing: fall through to the worst offender
+    mon = _firing_monitor("burning")
+    for _ in range(4):
+        mon.observe("loud", KIND_TTFT, 2.0, 0.5)
+    assert mon.firing_any("loud")
+    fe = _policy_frontend(tenants, slo=mon)
+    victim = fe._pick_shed_victim(incoming, waiting())
+    assert victim is not None and victim.tenant == "burning"
+
+
+def test_hostile_tenant_name_metrics(monkeypatch):
+    """Caller-supplied tenant names cannot smuggle label syntax or
+    newlines into the Prometheus textfile, and two hostile names that
+    sanitize alike stay distinct series (crc disambiguation)."""
+    reg = MetricsRegistry()
+    reg.enabled = True
+    monkeypatch.setattr(
+        "deepspeed_tpu.inference.serving.frontend.frontend.get_registry",
+        lambda: reg)
+    fe = _policy_frontend([])
+    hostile = 'evil{label="x"}\n# HELP bogus fake'
+    tm = fe._tenant_metrics(hostile)
+    tm["tokens"].inc()
+    for m in tm.values():
+        assert re.fullmatch(r"[a-zA-Z_][a-zA-Z0-9_]*", m.name), m.name
+    # names differing only in punctuation stay distinct series
+    ta, tb = fe._tenant_metrics("a b"), fe._tenant_metrics("a.b")
+    assert ta["tokens"].name != tb["tokens"].name
+    text = reg.to_prometheus()
+    assert 'label="x"' not in text
+    assert "HELP bogus" not in text
+    for line in text.splitlines():
+        assert line.startswith(("#", "dstpu_")), line
+
+
+def test_on_token_feeds_slo_and_exemplars(monkeypatch):
+    """The token hook forwards TTFT / ITL samples to the burn-rate
+    monitor against the tenant's SLO targets and attaches the request's
+    trace id as a histogram exemplar."""
+    reg = MetricsRegistry()
+    reg.enabled = True
+    monkeypatch.setattr(
+        "deepspeed_tpu.inference.serving.frontend.frontend.get_registry",
+        lambda: reg)
+    mon = SloMonitor(objective=0.9, fast_window_s=10.0,
+                     slow_window_s=100.0, min_samples=1,
+                     registry=MetricsRegistry())
+    fe = _policy_frontend(
+        [TenantSpec("t", ttft_slo_s=0.5, itl_slo_s=0.1)], slo=mon)
+    req = SimpleNamespace(prompt=[1, 2], submit_time=10.0,
+                          trace_id="r0-000001")
+    fe._on_token(SimpleNamespace(token=7, index=0, tenant="t",
+                                 request=req, time_s=11.0,
+                                 prev_time_s=None))
+    fe._on_token(SimpleNamespace(token=8, index=1, tenant="t",
+                                 request=req, time_s=11.3,
+                                 prev_time_s=11.0))
+    snap = mon.snapshot()
+    assert snap[f"t/{KIND_TTFT}"]["samples"] == 1
+    assert snap[f"t/{KIND_ITL}"]["samples"] == 1
+    tm = fe._tenant_metrics("t")
+    assert [x[0] for x in tm["ttft"].exemplars().values()] \
+        == ["r0-000001"]
+    assert 'trace_id="r0-000001"' in reg.to_prometheus()
 
 
 # ---------------------------------------------------------------------------
