@@ -43,6 +43,54 @@ class ServingMeshConfig(ConfigModel):
         return self
 
 
+class HostCacheConfig(ConfigModel):
+    """``serving.host_cache`` block — the tiered host prefix cache
+    (docs/serving.md "Tiered prefix cache").
+
+    With ``enabled``, refcount-0 blocks the pool LRU evicts are
+    DEMOTED instead of forgotten: encoded through the quantizer wire
+    codec into a host DRAM slot store (first ``dram_budget_bytes``),
+    overflowing to an NVMe-backed store (``nvme_budget_bytes`` at
+    ``nvme_path``), keyed by the same chained content digest as the
+    device radix index.  A prefix hit on a spilled chain claims pool
+    blocks immediately and streams the payloads back during the
+    admission/prefill window (at most ``promote_parallelism`` block
+    scatters per engine step) — warm TTFT at host-copy cost instead of
+    recompute cost."""
+    enabled: bool = C.SERVING_HOST_CACHE_ENABLED_DEFAULT
+    dram_budget_bytes: int = C.SERVING_HOST_CACHE_DRAM_BUDGET_BYTES_DEFAULT
+    nvme_budget_bytes: int = C.SERVING_HOST_CACHE_NVME_BUDGET_BYTES_DEFAULT
+    nvme_path: Optional[str] = C.SERVING_HOST_CACHE_NVME_PATH_DEFAULT
+    promote_parallelism: int = \
+        C.SERVING_HOST_CACHE_PROMOTE_PARALLELISM_DEFAULT
+    wire_bits: int = C.SERVING_HOST_CACHE_WIRE_BITS_DEFAULT
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.dram_budget_bytes < 0 or self.nvme_budget_bytes < 0:
+            raise ValueError(
+                "serving.host_cache budgets must be >= 0 (0 = tier off)")
+        if self.enabled and not (self.dram_budget_bytes
+                                 or self.nvme_budget_bytes):
+            raise ValueError(
+                "serving.host_cache.enabled needs dram_budget_bytes "
+                "and/or nvme_budget_bytes > 0")
+        if self.nvme_budget_bytes and not self.nvme_path:
+            raise ValueError(
+                "serving.host_cache.nvme_budget_bytes > 0 requires "
+                "nvme_path (directory for the backing file)")
+        if self.promote_parallelism < 1:
+            raise ValueError(
+                f"serving.host_cache.promote_parallelism must be >= 1, "
+                f"got {self.promote_parallelism}")
+        if self.wire_bits not in (0, 4, 8):
+            raise ValueError(
+                f"serving.host_cache.wire_bits must be one of 0 (raw "
+                f"dtype bytes), 8 (int8) or 4 (packed int4), got "
+                f"{self.wire_bits}")
+        return self
+
+
 class ServingConfig(ConfigModel):
     """``serving`` block — continuous-batching inference
     (`inference/serving/`, docs/serving.md).
@@ -104,6 +152,9 @@ class ServingConfig(ConfigModel):
     # data | max_batch_slots) are checked at ServingEngine build, where
     # the model is known
     mesh: ServingMeshConfig = Field(default_factory=ServingMeshConfig)
+    # tiered host prefix cache: spill LRU-evicted blocks to host
+    # DRAM/NVMe and promote on hit — see HostCacheConfig
+    host_cache: HostCacheConfig = Field(default_factory=HostCacheConfig)
 
     @model_validator(mode="after")
     def _validate(self):

@@ -23,13 +23,17 @@ from .block_allocator import (BlockPoolError, NULL_BLOCK,  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
 from .frontend import (ServingFrontend, StreamCollector,  # noqa: F401
                        TokenEvent, TenantRegistry, TenantSpec)
+from .host_cache import (BlockCodec, HostTierCache,  # noqa: F401
+                         host_block_bytes, tiered_blocks_for_budget)
 from .scheduler import (ContinuousBatchingScheduler, Request,  # noqa: F401
                         RequestState, RequestStatus)
 
-__all__ = ["BlockPoolError", "NULL_BLOCK", "PagedBlockAllocator",
-           "ContinuousBatchingScheduler", "Request", "RequestState",
-           "RequestStatus", "ServingEngine", "ServingError",
-           "ServingFrontend", "SloAlert", "SloMonitor",
+__all__ = ["BlockCodec", "BlockPoolError", "NULL_BLOCK",
+           "PagedBlockAllocator",
+           "ContinuousBatchingScheduler", "HostTierCache", "Request",
+           "RequestState", "RequestStatus", "ServingEngine",
+           "ServingError", "ServingFrontend", "SloAlert", "SloMonitor",
            "StreamCollector", "TokenEvent",
            "TenantRegistry", "TenantSpec",
-           "kv_block_bytes", "blocks_for_budget"]
+           "host_block_bytes", "kv_block_bytes", "blocks_for_budget",
+           "tiered_blocks_for_budget"]

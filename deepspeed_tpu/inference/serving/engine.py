@@ -57,9 +57,11 @@ from ...parallel.shard_map_compat import shard_map
 from ...runtime.resilience.errors import (FatalIOError, ServingError,
                                           TransientIOError)
 from ...runtime.resilience.fault_injection import get_fault_injector
+from ...runtime.resilience.retry import retry_call
 from ...utils.logging import logger
 from ..sampling import fold_in_keys, sample_tokens_per_row
 from .block_allocator import PagedBlockAllocator
+from .host_cache import BlockCodec, HostTierCache
 from .frontend.streaming import TokenEvent
 from .scheduler import (ContinuousBatchingScheduler, Request,
                         RequestState, RequestStatus)
@@ -197,6 +199,33 @@ class ServingEngine:
             f"{self.chunk_tokens} tokens, prefix cache "
             f"{'on' if cfg.prefix_cache else 'off'}")
 
+        # donation keeps the pools in-place on TPU; the CPU backend
+        # does not implement donation and would warn every dispatch
+        self._donate = jax.default_backend() == "tpu"
+
+        # -- tiered host prefix cache (docs/serving.md "Tiered prefix
+        # cache"): LRU-evicted registered blocks demote into host
+        # DRAM/NVMe through the wire codec; hits on spilled chains
+        # promote back during the admission/prefill window ---------------
+        self.host_cache: Optional[HostTierCache] = None
+        self._hc_codec: Optional[BlockCodec] = None
+        self._gather_block = self._scatter_block = None
+        self._promote_k = cfg.host_cache.promote_parallelism
+        #: plain-int mirrors for bench_all / callers without the registry
+        self.host_counts = {"promoted_blocks": 0, "promote_failures": 0,
+                            "spill_failures": 0}
+        #: wall seconds inside _service_promotions — with
+        #: ``promoted_blocks * codec.nbytes`` this is the promote
+        #: bandwidth the tiered-cache bench reports
+        self.promote_seconds = 0.0
+        if cfg.host_cache.enabled:
+            if not cfg.prefix_cache:
+                raise ValueError(
+                    "serving.host_cache.enabled requires "
+                    "serving.prefix_cache — the host tier is keyed by "
+                    "the radix index's content digests")
+            self._init_host_cache(cfg.host_cache)
+
         self.temperature = engine.config.temperature
         self.top_k = engine.config.top_k
         self.top_p = engine.config.top_p
@@ -228,9 +257,6 @@ class ServingEngine:
         self.token_hooks: List[Callable] = []
         self.lifecycle_hooks: List[Callable] = []
         self._event_buf: List[TokenEvent] = []
-        # donation keeps the pools in-place on TPU; the CPU backend
-        # does not implement donation and would warn every dispatch
-        self._donate = jax.default_backend() == "tpu"
 
         reg = get_registry()
         self._m_queue = reg.gauge(
@@ -336,10 +362,53 @@ class ServingEngine:
         #: plain-int mirror for bench_all (acceptance_rate =
         #: accepted / proposed)
         self.spec_counts = {"proposed": 0, "accepted": 0}
+        # tiered host cache metrics (docs/serving.md "Tiered prefix
+        # cache"): per-tier hit/spill/evict counters, resident-bytes and
+        # promote-queue-depth gauges
+        self._m_host_spills = reg.counter(
+            "dstpu_serving_host_spills_total",
+            "evicted KV blocks demoted into the host tier (vs forgotten)")
+        self._m_host_dram_hits = reg.counter(
+            "dstpu_serving_host_dram_hits_total",
+            "prefix-hit blocks claimed out of the host DRAM tier")
+        self._m_host_nvme_hits = reg.counter(
+            "dstpu_serving_host_nvme_hits_total",
+            "prefix-hit blocks claimed out of the host NVMe tier")
+        self._m_host_demotions = reg.counter(
+            "dstpu_serving_host_demotions_total",
+            "entries pushed DRAM -> NVMe under host-tier pressure")
+        self._m_host_evictions = reg.counter(
+            "dstpu_serving_host_evictions_total",
+            "entries aged out of the host tier entirely")
+        self._m_host_hit_tokens = reg.counter(
+            "dstpu_serving_host_hit_tokens_total",
+            "prompt tokens served by host-tier promotion instead of "
+            "recompute")
+        self._m_promoted = reg.counter(
+            "dstpu_serving_promoted_blocks_total",
+            "host-tier payloads landed back into the device pool")
+        self._m_promote_failures = reg.counter(
+            "dstpu_serving_promote_failures_total",
+            "promotions dropped to recompute (fatal fault / bad payload)")
+        self._m_spill_failures = reg.counter(
+            "dstpu_serving_spill_failures_total",
+            "spills degraded to plain eviction (host store fault)")
+        self._m_host_dram_bytes = reg.gauge(
+            "dstpu_serving_host_dram_bytes",
+            "encoded KV bytes resident in the host DRAM tier")
+        self._m_host_nvme_bytes = reg.gauge(
+            "dstpu_serving_host_nvme_bytes",
+            "encoded KV bytes resident in the host NVMe tier")
+        self._m_promote_depth = reg.gauge(
+            "dstpu_serving_promote_queue_depth",
+            "claimed host payloads waiting to land in the pool")
         # counter deltas are polled off the (jax-free) allocator's
         # cumulative ints
         self._hits_polled = 0
         self._evictions_polled = 0
+        self._host_polled = {"spills": 0, "dram_hits": 0, "nvme_hits": 0,
+                             "demotions": 0, "evictions": 0,
+                             "hit_tokens": 0}
 
     # ------------------------------------------------------------------
     # tensor-parallel serving (docs/serving.md "Tensor-parallel serving")
@@ -466,6 +535,194 @@ class ServingEngine:
         if self._pool_ks is not None:
             total += self._pool_ks.nbytes + self._pool_vs.nbytes
         return total // self.tp_model_size
+
+    # ------------------------------------------------------------------
+    # tiered host prefix cache (docs/serving.md "Tiered prefix cache")
+    # ------------------------------------------------------------------
+    def _init_host_cache(self, hc) -> None:
+        """Build the host tier from the pool geometry and wire it into
+        the allocator: eviction becomes demotion (``_spill_block``),
+        and the allocate hit walk extends into the host store.  The
+        gather/scatter helper programs are compiled HERE, off the
+        serving clock, by round-tripping the null block — the mixed
+        step stays the one program (``decode_builds`` untouched)."""
+        c = self.model.config
+        self._hc_codec = BlockCodec(
+            c.num_layers, self.block_size, c.kv_heads, c.hdim,
+            kv_bits=self.kv_bits, wire_bits=hc.wire_bits,
+            dtype=np.dtype(self._pool_k.dtype) if not self.kv_bits
+            else np.int8)
+        entry = self._hc_codec.nbytes
+        dram_slots = hc.dram_budget_bytes // entry
+        nvme_slots = hc.nvme_budget_bytes // entry
+        if dram_slots == 0 and nvme_slots == 0:
+            raise ValueError(
+                f"serving.host_cache budgets admit zero entries — one "
+                f"encoded block is {entry} bytes ({c.num_layers} layers "
+                f"x {self.block_size} tokens x {c.kv_heads} kv heads at "
+                f"{self._hc_codec.at_rest_bits or 'raw'} bits)")
+        self.host_cache = HostTierCache(
+            entry, dram_slots, nvme_slots=nvme_slots,
+            nvme_path=hc.nvme_path,
+            buffer_count=max(4, self._promote_k))
+        self.allocator.attach_host_tier(self.host_cache,
+                                        self._spill_block)
+        # block-granular DMA helpers: tiny jitted gather/scatter over
+        # the pools (NOT the mixed step — these run in the admission
+        # window, never per decode token)
+        if self.kv_bits:
+            self._gather_block = jax.jit(
+                lambda pk, pv, pks, pvs, b:
+                (pk[:, b], pv[:, b], pks[:, b], pvs[:, b]))
+            self._scatter_block = jax.jit(
+                lambda pk, pv, pks, pvs, b, k, v, ks, vs:
+                (pk.at[:, b].set(k), pv.at[:, b].set(v),
+                 pks.at[:, b].set(ks), pvs.at[:, b].set(vs)),
+                donate_argnums=(0, 1, 2, 3) if self._donate else ())
+        else:
+            self._gather_block = jax.jit(
+                lambda pk, pv, b: (pk[:, b], pv[:, b]))
+            self._scatter_block = jax.jit(
+                lambda pk, pv, b, k, v:
+                (pk.at[:, b].set(k), pv.at[:, b].set(v)),
+                donate_argnums=(0, 1) if self._donate else ())
+        # compile warmup: scatter the null block's own content back into
+        # itself — a semantic no-op that traces both programs now
+        b0 = jnp.asarray(0, jnp.int32)
+        if self.kv_bits:
+            k, v, ks, vs = self._gather_block(
+                self._pool_k, self._pool_v, self._pool_ks,
+                self._pool_vs, b0)
+            (self._pool_k, self._pool_v, self._pool_ks,
+             self._pool_vs) = self._scatter_block(
+                self._pool_k, self._pool_v, self._pool_ks,
+                self._pool_vs, b0, k, v, ks, vs)
+        else:
+            k, v = self._gather_block(self._pool_k, self._pool_v, b0)
+            self._pool_k, self._pool_v = self._scatter_block(
+                self._pool_k, self._pool_v, b0, k, v)
+        logger.info(
+            f"serving: tiered host cache on — entry {entry / 2**10:.1f} "
+            f"KiB at {self._hc_codec.at_rest_bits or 'raw'}-bit, "
+            f"dram {dram_slots} entries"
+            f"{f', nvme {nvme_slots} entries' if nvme_slots else ''}, "
+            f"promote parallelism {self._promote_k}")
+
+    def _spill_block(self, block: int, digest: bytes) -> None:
+        """Allocator eviction callback: encode the dying block and park
+        it in the host tier under its chain digest.  NEVER raises — the
+        ``serving.spill`` fault site (transient faults retried under
+        the resilience backoff) degrades any terminal failure to a
+        plain eviction, so a sick host store costs warmth, not
+        correctness, and never a wrong block."""
+        try:
+            with trace_span("serving/spill", block=block):
+                bi = jnp.asarray(block, jnp.int32)
+                if self.kv_bits:
+                    k, v, ks, vs = self._gather_block(
+                        self._pool_k, self._pool_v, self._pool_ks,
+                        self._pool_vs, bi)
+                    payload = self._hc_codec.encode(
+                        np.asarray(k), np.asarray(v),
+                        np.asarray(ks), np.asarray(vs))
+                else:
+                    k, v = self._gather_block(self._pool_k,
+                                              self._pool_v, bi)
+                    payload = self._hc_codec.encode(np.asarray(k),
+                                                    np.asarray(v))
+
+                def _put():
+                    get_fault_injector().check("serving.spill")
+                    self.host_cache.put(digest, payload)
+                retry_call(_put, what=f"host-tier spill of block {block}")
+        except Exception as e:   # noqa: BLE001 — degrade, never raise
+            self.host_counts["spill_failures"] += 1
+            self._m_spill_failures.inc()
+            logger.warning(
+                f"serving: spill of block {block} failed ({e!r}) — "
+                f"degraded to plain eviction")
+
+    def _service_promotions(self) -> int:
+        """Land up to ``promote_parallelism`` queued host->pool block
+        promotions (admission-window work: the scheduler holds the
+        owning requests in the PROMOTING phase until their blocks
+        land).  Transient ``serving.promote`` faults that outlive the
+        in-call retry budget leave the job queued for next step; a
+        fatal fault drops the job AND its registration and rolls every
+        holder back to recompute (``promotion_failed``) — stale or
+        mismatched KV is never served.  Returns blocks landed (counts
+        as watchdog progress)."""
+        alloc = self.allocator
+        if self.host_cache is None or not alloc.num_pending:
+            return 0
+        sched = self.scheduler
+        promoting = [r for r in sched.running.values()
+                     if sched.promoting(r)]
+        t0 = time.perf_counter()
+        landed = 0
+        for job in alloc.pending_jobs()[:self._promote_k]:
+            try:
+                with trace_span("serving/promote", block=job.block):
+                    def _land():
+                        # the fault site fires BEFORE the scatter, so a
+                        # fault leaves the pool untouched; the scatter
+                        # itself is idempotent under retry
+                        get_fault_injector().check("serving.promote")
+                        self._land_promotion(job)
+                    retry_call(_land,
+                               what=f"host-tier promote of block "
+                                    f"{job.block}")
+            except TransientIOError as e:
+                # retry budget exhausted but the fault is transient:
+                # the job stays queued and retries next step (the
+                # request stays PROMOTING — delayed, never corrupted)
+                logger.warning(
+                    f"serving: promote of block {job.block} still "
+                    f"transient after retries — queued for next step: "
+                    f"{e}")
+                continue
+            except Exception as e:   # noqa: BLE001 — fatal: recompute
+                affected = alloc.promotion_failed(job.digest)
+                self.host_counts["promote_failures"] += 1
+                self._m_promote_failures.inc()
+                for seq_id, block_index in affected:
+                    for req in sched.running.values():
+                        if req.req_id == seq_id:
+                            # roll back to the last row BEFORE the dead
+                            # block: prefill recomputes from there
+                            # (rewriting identical content, so the
+                            # chain record stays valid)
+                            req.cached_tokens = min(
+                                req.cached_tokens,
+                                block_index * self.block_size)
+                logger.warning(
+                    f"serving: promote of block {job.block} failed "
+                    f"fatally ({e!r}) — host entry dropped, "
+                    f"{len(affected)} holder(s) fall back to recompute")
+                continue
+            alloc.promotion_landed(job.digest)
+            landed += 1
+            self.host_counts["promoted_blocks"] += 1
+            self._m_promoted.inc()
+        dur = time.perf_counter() - t0
+        self.promote_seconds += dur
+        if landed and self._rt.enabled:
+            self._rt.on_promote(promoting, t0, dur, landed)
+        return landed
+
+    def _land_promotion(self, job) -> None:
+        """Decode one claimed payload and scatter it into the pool at
+        its claimed block."""
+        k, v, ks, vs = self._hc_codec.decode(job.payload)
+        bi = jnp.asarray(job.block, jnp.int32)
+        if self.kv_bits:
+            (self._pool_k, self._pool_v, self._pool_ks,
+             self._pool_vs) = self._scatter_block(
+                self._pool_k, self._pool_v, self._pool_ks,
+                self._pool_vs, bi, k, v, ks, vs)
+        else:
+            self._pool_k, self._pool_v = self._scatter_block(
+                self._pool_k, self._pool_v, bi, k, v)
 
     # ------------------------------------------------------------------
     # speculative decoding (draft lane)
@@ -1159,10 +1416,17 @@ class ServingEngine:
             logger.info(f"serving: preempted {req.req_id} on KV pressure "
                         f"({req.preemptions} time(s))")
         sched.schedule_admissions()
+        # land queued host->pool promotions in the admission window:
+        # PROMOTING requests are held out of next_prefill_chunk until
+        # their claimed blocks carry real KV again
+        promoted = self._service_promotions()
         self._drain_terminal_events()
         self._update_gauges()
 
-        progress = 0
+        # a landed promotion MOVED state (the request it unblocks may
+        # only prefill next iteration) — count it as progress so a
+        # promote-only iteration never trips the watchdog
+        progress = promoted
         budget = self.chunk_tokens
         include_decode = True
         while True:
@@ -1251,6 +1515,8 @@ class ServingEngine:
             "lifecycle": dict(self.lifecycle_counts),
             "spec": dict(self.spec_counts),
             "decode_builds": self.decode_builds,
+            "host_pending": alloc.num_pending,
+            "host": dict(self.host_counts),
         }
 
     def _diagnose(self, headline: str) -> str:
@@ -1289,6 +1555,30 @@ class ServingEngine:
         if d:
             self._m_evictions.inc(d)
             self._evictions_polled += d
+        hc = self.host_cache
+        if hc is None:
+            return
+        hp = self._host_polled
+        for key, counter, cur in (
+                ("spills", self._m_host_spills, hc.spills_total),
+                ("demotions", self._m_host_demotions, hc.demotions_total),
+                ("evictions", self._m_host_evictions, hc.evictions_total),
+                ("dram_hits", self._m_host_dram_hits,
+                 hc.hits_total.get("dram", 0)),
+                ("nvme_hits", self._m_host_nvme_hits,
+                 hc.hits_total.get("nvme", 0)),
+                ("hit_tokens", self._m_host_hit_tokens,
+                 self.allocator.host_hit_tokens_total)):
+            d = cur - hp[key]
+            if d:
+                counter.inc(d)
+                hp[key] += d
+        tiers = hc.tier_names
+        if "dram" in tiers:
+            self._m_host_dram_bytes.set(hc.resident_bytes("dram"))
+        if "nvme" in tiers:
+            self._m_host_nvme_bytes.set(hc.resident_bytes("nvme"))
+        self._m_promote_depth.set(self.allocator.num_pending)
 
     def _default_max_steps(self) -> int:
         """A generous drain bound computed from the queued work: enough
@@ -1307,6 +1597,10 @@ class ServingEngine:
             # token the request may ever generate
             prefix = len(r.prompt) + r.max_new_tokens
             steps += -(-prefix // self.chunk_tokens) + r.max_new_tokens + 2
+            if self.host_cache is not None:
+                # a fully host-warm prefix promotes promote_parallelism
+                # blocks per iteration while the request waits PROMOTING
+                steps += -(-prefix // self.block_size)
         allowance = (sched.max_preemptions or 8) + 1
         return steps * allowance + 64
 

@@ -1,0 +1,412 @@
+"""Host-tier prefix cache: evicted KV blocks spill to DRAM/NVMe slots.
+
+The "infinite" half of the tiered prefix cache (docs/serving.md
+&sect;Tiered prefix cache).  At serving scale the shared prompts worth
+caching vastly exceed HBM, so the :mod:`block_allocator`'s LRU eviction
+is turned into a *demotion*: instead of forgetting a refcount-0
+registered block, the engine encodes it through the quantizer wire
+codec and parks the bytes here, keyed by the SAME chained content
+digest that keys the radix index.  A later prefix hit on a spilled
+chain finds the digest in this cache and promotes the block back into
+the pool asynchronously — paying a host->device copy instead of a full
+prefill recompute.
+
+This mirrors the ZeRO-Infinity stance (PAPER.md layer 7): host DRAM
+and NVMe are just slower tiers of one memory hierarchy, and the
+storage layer is literally the same ``swap_tensor`` slot stores the
+optimizer offload uses (``DramSlotStore`` view-based access, the
+``NvmeSlotStore`` pinned-buffer aio ring with retry + backoff).
+
+Correctness stance, same as the device-side radix cache: the lookup
+key IS the chain hash — a blake2b-128 digest over the block's tokens
+AND its prefix's digest — so a host hit is content-verified against
+its chain parent by construction; a stale child whose parent was
+dropped is unreachable, never wrong.  Invariants
+(:meth:`HostTierCache.assert_consistent`, fuzzed by the allocator
+property test):
+
+  * a digest is resident in AT MOST one tier (DRAM xor NVMe), and —
+    because spill unregisters and promote claims — never resident both
+    host-side and in the device radix index;
+  * every tier slot is exactly one of free or owned by one digest.
+
+Like the allocator, this module is pure host code (numpy + slot
+stores, no jax, no observability imports): counters are plain ints the
+serving engine polls into the metrics registry.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ...runtime.swap_tensor.slot_store import SlotStore, make_slot_store
+from .block_allocator import blocks_for_budget, kv_block_bytes
+
+__all__ = ["BlockCodec", "HostTierCache", "host_block_bytes",
+           "tiered_blocks_for_budget"]
+
+
+# -- capacity planning (second tier over blocks_for_budget) ----------------
+
+def host_block_bytes(num_layers: int, block_size: int, kv_heads: int,
+                     head_dim: int, kv_bits: int = 0, wire_bits: int = 8,
+                     cache_itemsize: int = 2) -> int:
+    """Encoded bytes ONE pool block costs in a host-tier slot: all
+    layers, k AND v, scale planes included, UNSHARDED kv heads (the
+    host entry is the gathered global block even when the device pool
+    shards heads over the model axis).  A quantized pool (``kv_bits``
+    8/4) spills its int8/int4 bytes verbatim — compressed at rest for
+    free; an unquantized pool is encoded at ``wire_bits`` (0 = raw
+    dtype bytes).  Per-layer cost delegates to :func:`kv_block_bytes`
+    so both tiers stay pinned to one formula."""
+    at_rest_bits = kv_bits if kv_bits else wire_bits
+    return num_layers * kv_block_bytes(block_size, kv_heads, head_dim,
+                                       at_rest_bits, cache_itemsize)
+
+
+def tiered_blocks_for_budget(hbm_budget_bytes: int, dram_budget_bytes: int,
+                             nvme_budget_bytes: int, num_layers: int,
+                             block_size: int, kv_heads: int, head_dim: int,
+                             kv_bits: int = 0, wire_bits: int = 8,
+                             cache_itemsize: int = 2,
+                             model_shards: int = 1
+                             ) -> Tuple[int, int, int]:
+    """Capacity planning over the full hierarchy: ``(hbm_blocks,
+    dram_blocks, nvme_blocks)``.  The HBM count is per-chip (same
+    contract as :func:`blocks_for_budget`, including the null block);
+    the host counts are whole-block slots at the host encoding — a
+    pool block and its host entry are different sizes whenever the
+    wire codec compresses or the mesh shards heads."""
+    hbm = blocks_for_budget(hbm_budget_bytes, block_size, kv_heads,
+                            head_dim, kv_bits, cache_itemsize, model_shards)
+    entry = host_block_bytes(num_layers, block_size, kv_heads, head_dim,
+                             kv_bits, wire_bits, cache_itemsize)
+    return hbm, dram_budget_bytes // entry, nvme_budget_bytes // entry
+
+
+# -- wire codec (numpy mirror of ops/quantizer kv_quantize) ----------------
+
+def _np_kv_quantize(x: np.ndarray, num_bits: int
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Numpy twin of ``ops/quantizer.kv_quantize`` (same per-row
+    per-head symmetric scales, same FEATURE-SPLIT int4 packing) so the
+    host tier never traces a jax program just to encode bytes."""
+    d = x.shape[-1]
+    qmax = 2.0 ** (num_bits - 1) - 1
+    xf = x.astype(np.float32)
+    scale = np.maximum(np.max(np.abs(xf), axis=-1) / qmax, 1e-8)
+    q = np.clip(np.rint(xf / scale[..., None]), -qmax - 1, qmax)
+    q = q.astype(np.int32)
+    if num_bits == 4:
+        lo, hi = q[..., :d // 2], q[..., d // 2:]
+        q = (lo & 0xF) | ((hi & 0xF) << 4)
+    return q.astype(np.int8), scale.astype(np.float32)
+
+
+def _np_kv_dequantize(q: np.ndarray, scale: np.ndarray, num_bits: int,
+                      dtype) -> np.ndarray:
+    x = q.astype(np.int32)
+    if num_bits == 4:
+        lo = ((x & 0xF) ^ 8) - 8
+        hi = x >> 4
+        x = np.concatenate([lo, hi], axis=-1)
+    return (x.astype(np.float32) * scale[..., None]).astype(dtype)
+
+
+class BlockCodec:
+    """Encode/decode one pool block ``(k, v[, k_scale, v_scale])`` —
+    shapes ``[L, block_size, kv_heads, d_eff]`` (+ ``[L, bs, kvh]``
+    scales when the pool is quantized) — to/from one flat uint8 host
+    payload.
+
+    A quantized pool round-trips BYTE-EXACT (raw int8/int4 values +
+    f32 scale planes), which is what makes greedy streams
+    token-identical across a spill/promote cycle at ``kv_cache_bits
+    in (4, 8)``.  An unquantized (bf16) pool is quantized on the way
+    out at ``wire_bits`` (0 keeps raw dtype bytes — lossless but 2-4x
+    the host footprint)."""
+
+    def __init__(self, num_layers: int, block_size: int, kv_heads: int,
+                 head_dim: int, kv_bits: int = 0, wire_bits: int = 8,
+                 dtype=np.float32):
+        if kv_bits not in (0, 4, 8):
+            raise ValueError(f"kv_bits must be 0, 4 or 8, got {kv_bits}")
+        if wire_bits not in (0, 4, 8):
+            raise ValueError(f"wire_bits must be 0, 4 or 8, got {wire_bits}")
+        self.num_layers = num_layers
+        self.block_size = block_size
+        self.kv_heads = kv_heads
+        self.head_dim = head_dim
+        self.kv_bits = kv_bits
+        self.wire_bits = wire_bits
+        self.dtype = np.dtype(dtype)
+        #: bits of the host representation: a quantized pool spills its
+        #: own encoding verbatim; a raw pool encodes at wire_bits
+        self.at_rest_bits = kv_bits if kv_bits else wire_bits
+        if self.at_rest_bits == 4 and head_dim % 2:
+            raise ValueError(f"packed int4 needs an even head_dim, "
+                             f"got {head_dim}")
+        rows = num_layers * block_size * kv_heads
+        if self.at_rest_bits == 0:
+            self._values_nbytes = rows * head_dim * self.dtype.itemsize
+            self._scales_nbytes = 0
+        else:
+            d_eff = head_dim if self.at_rest_bits == 8 else head_dim // 2
+            self._values_nbytes = rows * d_eff
+            self._scales_nbytes = rows * 4
+        self.nbytes = 2 * (self._values_nbytes + self._scales_nbytes)
+
+    def _vshape(self) -> Tuple[int, int, int, int]:
+        d_eff = (self.head_dim if self.at_rest_bits in (0, 8)
+                 else self.head_dim // 2)
+        return (self.num_layers, self.block_size, self.kv_heads, d_eff)
+
+    def _sshape(self) -> Tuple[int, int, int]:
+        return (self.num_layers, self.block_size, self.kv_heads)
+
+    @staticmethod
+    def _raw(a: np.ndarray) -> np.ndarray:
+        return np.ascontiguousarray(a).view(np.uint8).ravel()
+
+    def encode(self, k: np.ndarray, v: np.ndarray,
+               k_scale: Optional[np.ndarray] = None,
+               v_scale: Optional[np.ndarray] = None) -> np.ndarray:
+        """``uint8[nbytes]`` payload, layout ``k | v | k_scale |
+        v_scale``.  For a quantized pool k/v are the pool's int8 bytes
+        and the scale planes are REQUIRED; for a raw pool they must be
+        absent and are derived here when ``wire_bits`` compresses."""
+        if self.kv_bits:
+            if k_scale is None or v_scale is None:
+                raise ValueError("quantized pool spill needs scale planes")
+            qk, qv = np.asarray(k), np.asarray(v)
+            sk = np.asarray(k_scale, np.float32)
+            sv = np.asarray(v_scale, np.float32)
+        elif self.wire_bits:
+            qk, sk = _np_kv_quantize(np.asarray(k), self.wire_bits)
+            qv, sv = _np_kv_quantize(np.asarray(v), self.wire_bits)
+        else:
+            out = np.concatenate([self._raw(np.asarray(k)),
+                                  self._raw(np.asarray(v))])
+            assert out.nbytes == self.nbytes
+            return out
+        out = np.concatenate([self._raw(qk), self._raw(qv),
+                              self._raw(sk), self._raw(sv)])
+        assert out.nbytes == self.nbytes
+        return out
+
+    def decode(self, payload: np.ndarray
+               ) -> Tuple[np.ndarray, np.ndarray,
+                          Optional[np.ndarray], Optional[np.ndarray]]:
+        """Inverse of :meth:`encode`: ``(k, v, k_scale, v_scale)`` in
+        the POOL's representation — int8 values + f32 scales for a
+        quantized pool (scatter them verbatim), pool-dtype floats (and
+        ``None`` scales) for a raw pool."""
+        buf = np.asarray(payload, np.uint8).ravel()[:self.nbytes]
+        if buf.nbytes != self.nbytes:
+            raise ValueError(f"host payload {buf.nbytes} B, codec "
+                             f"expects {self.nbytes} B")
+        vn, sn = self._values_nbytes, self._scales_nbytes
+        if self.at_rest_bits == 0:
+            k = buf[:vn].view(self.dtype).reshape(self._vshape())
+            v = buf[vn:2 * vn].view(self.dtype).reshape(self._vshape())
+            return k, v, None, None
+        qk = buf[:vn].view(np.int8).reshape(self._vshape())
+        qv = buf[vn:2 * vn].view(np.int8).reshape(self._vshape())
+        sk = buf[2 * vn:2 * vn + sn].view(np.float32).reshape(self._sshape())
+        sv = buf[2 * vn + sn:].view(np.float32).reshape(self._sshape())
+        if self.kv_bits:
+            return qk, qv, sk, sv
+        k = _np_kv_dequantize(qk, sk, self.wire_bits, self.dtype)
+        v = _np_kv_dequantize(qv, sv, self.wire_bits, self.dtype)
+        return k, v, None, None
+
+
+# -- the tiered store ------------------------------------------------------
+
+class _Tier:
+    """One host tier: a slot store plus the digest->slot map in LRU
+    order and the free-slot list (LIFO, same warm-page rationale as
+    the allocator's free list)."""
+
+    __slots__ = ("name", "store", "free_slots", "lru")
+
+    def __init__(self, name: str, store: SlotStore, n_slots: int):
+        self.name = name
+        self.store = store
+        self.free_slots: List[int] = list(range(n_slots - 1, -1, -1))
+        self.lru: "OrderedDict[bytes, int]" = OrderedDict()
+
+
+class HostTierCache:
+    """Digest-keyed cache of encoded KV blocks over DRAM (+ optional
+    NVMe behind it).  Fixed-size entries (``entry_nbytes`` from
+    :class:`BlockCodec`), demand-paged hierarchy: spills land in DRAM;
+    a full DRAM demotes ITS oldest entry to NVMe; a full NVMe drops
+    its oldest — the cold tail ages out of the machine entirely.
+
+    Ownership protocol: a hit calls :meth:`claim`, which REMOVES the
+    entry and hands the payload to the caller — the digest is then "in
+    flight" toward the device pool, resident in neither tier, which
+    keeps the cross-tier disjointness invariant airtight at every op
+    boundary.  A cancelled promotion gives the bytes back via
+    :meth:`release_claim`."""
+
+    def __init__(self, entry_nbytes: int, dram_slots: int,
+                 nvme_slots: int = 0, nvme_path: Optional[str] = None,
+                 io_policy=None, buffer_count: int = 4,
+                 name: str = "kv_host_cache"):
+        if entry_nbytes < 1:
+            raise ValueError(f"entry_nbytes must be >= 1, got {entry_nbytes}")
+        if dram_slots < 0 or nvme_slots < 0:
+            raise ValueError("tier slot counts must be >= 0")
+        if dram_slots == 0 and nvme_slots == 0:
+            raise ValueError("host cache needs at least one tier slot")
+        self.entry_nbytes = entry_nbytes
+        self._tiers: List[_Tier] = []
+        if dram_slots:
+            self._tiers.append(_Tier(
+                "dram", make_slot_store("cpu", dram_slots, entry_nbytes),
+                dram_slots))
+        if nvme_slots:
+            self._tiers.append(_Tier(
+                "nvme", make_slot_store("nvme", nvme_slots, entry_nbytes,
+                                        nvme_path=nvme_path,
+                                        buffer_count=buffer_count,
+                                        io_policy=io_policy, name=name),
+                nvme_slots))
+        # cumulative stats, engine-polled (plain ints, no obs imports)
+        self.spills_total = 0        # blocks demoted out of HBM into here
+        self.demotions_total = 0     # dram -> nvme pressure moves
+        self.evictions_total = 0     # aged out of the machine entirely
+        self.hits_total: Dict[str, int] = {t.name: 0 for t in self._tiers}
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def tier_names(self) -> List[str]:
+        return [t.name for t in self._tiers]
+
+    def resident_entries(self, tier: str) -> int:
+        return len(self._tier(tier).lru)
+
+    def resident_bytes(self, tier: str) -> int:
+        return len(self._tier(tier).lru) * self.entry_nbytes
+
+    def digests(self) -> Set[bytes]:
+        out: Set[bytes] = set()
+        for t in self._tiers:
+            out |= set(t.lru)
+        return out
+
+    def contains(self, digest: bytes) -> bool:
+        return any(digest in t.lru for t in self._tiers)
+
+    def _tier(self, name: str) -> _Tier:
+        for t in self._tiers:
+            if t.name == name:
+                return t
+        raise KeyError(f"no host tier named {name!r}")
+
+    # -- write path -------------------------------------------------------
+    def put(self, digest: bytes, payload: np.ndarray) -> None:
+        """Spill one encoded block.  Re-putting a resident digest just
+        refreshes its LRU position (content-addressed: the bytes are
+        identical by construction)."""
+        for t in self._tiers:
+            if digest in t.lru:
+                t.lru.move_to_end(digest)
+                return
+        self.spills_total += 1
+        self._insert(0, digest, payload)
+
+    def release_claim(self, digest: bytes, payload: np.ndarray) -> None:
+        """A claimed promotion was cancelled before landing (request
+        freed / preempted mid-admission): give the bytes back so the
+        prefix stays warm.  Not counted as a spill."""
+        if self.contains(digest):            # re-spilled meanwhile
+            return
+        self._insert(0, digest, payload)
+
+    def _insert(self, tier_idx: int, digest: bytes,
+                payload: np.ndarray) -> None:
+        """Insert into tier ``tier_idx``, rippling evictions down the
+        hierarchy: a full tier demotes its LRU entry to the next tier;
+        the last tier's LRU entry is dropped."""
+        if tier_idx >= len(self._tiers):
+            self.evictions_total += 1        # nowhere colder to go
+            return
+        t = self._tiers[tier_idx]
+        if not t.free_slots:
+            victim_digest, victim_slot = t.lru.popitem(last=False)
+            victim_payload = t.store.read_slot(victim_slot,
+                                               self.entry_nbytes)
+            t.free_slots.append(victim_slot)
+            if tier_idx + 1 < len(self._tiers):
+                self.demotions_total += 1
+            self._insert(tier_idx + 1, victim_digest, victim_payload)
+        slot = t.free_slots.pop()
+        t.store.write_slot(slot, np.asarray(payload, np.uint8))
+        t.lru[digest] = slot
+
+    # -- read path --------------------------------------------------------
+    def claim(self, digest: bytes) -> Optional[np.ndarray]:
+        """Remove ``digest``'s entry and return its payload (None on
+        miss).  The caller owns the bytes until they land in the pool
+        (then simply dropped) or the promotion is cancelled
+        (:meth:`release_claim`)."""
+        for t in self._tiers:
+            slot = t.lru.pop(digest, None)
+            if slot is not None:
+                payload = t.store.read_slot(slot, self.entry_nbytes)
+                t.free_slots.append(slot)
+                self.hits_total[t.name] += 1
+                return payload
+        return None
+
+    def discard(self, digest: bytes) -> bool:
+        """Drop an entry without reading it — the device radix index
+        re-registered this digest (a sibling recomputed the same
+        content), so the host copy is redundant; dropping it keeps the
+        device/host residency disjoint."""
+        for t in self._tiers:
+            slot = t.lru.pop(digest, None)
+            if slot is not None:
+                t.free_slots.append(slot)
+                return True
+        return False
+
+    # -- invariants / teardown --------------------------------------------
+    def assert_consistent(self,
+                          device_digests: Optional[Set[bytes]] = None
+                          ) -> None:
+        """Slot accounting and cross-tier disjointness; with
+        ``device_digests`` (the allocator's registered hashes) also the
+        hierarchy-wide rule that a digest lives in at most one place."""
+        seen: Dict[bytes, str] = {}
+        for t in self._tiers:
+            n_slots = t.store.n_slots
+            used = list(t.lru.values())
+            if len(set(used)) != len(used):
+                raise AssertionError(f"{t.name}: duplicate slot ownership")
+            if set(used) & set(t.free_slots):
+                raise AssertionError(f"{t.name}: slot both free and owned")
+            if len(used) + len(t.free_slots) != n_slots:
+                raise AssertionError(
+                    f"{t.name}: {len(used)} used + {len(t.free_slots)} "
+                    f"free != {n_slots} slots")
+            for d in t.lru:
+                if d in seen:
+                    raise AssertionError(
+                        f"digest resident in both {seen[d]} and {t.name}")
+                seen[d] = t.name
+        if device_digests is not None:
+            both = set(seen) & device_digests
+            if both:
+                raise AssertionError(
+                    f"{len(both)} digest(s) resident both host-side and "
+                    f"in the device radix index")
+
+    def close(self) -> None:
+        for t in self._tiers:
+            t.store.close()

@@ -449,12 +449,20 @@ class ContinuousBatchingScheduler:
         token ``budget``: oldest-admitted prefilling slot (or the
         ``prefill_policy``'s choice), at most ``budget`` tokens of its
         remaining prefix.  Returns
-        ``(slot, request, start_row, n_tokens)`` or None."""
+        ``(slot, request, start_row, n_tokens)`` or None.
+
+        A PROMOTING request — host-tier cache hits still streaming
+        into its block table (docs/serving.md &sect;Tiered prefix
+        cache) — is held out: prefill attention gathers the whole
+        prefix, so computing the tail before the promoted blocks land
+        would read garbage rows.  It takes its chunk the step its last
+        payload lands, skipping straight to the uncached tail."""
         if budget < 1:
             return None
         prefilling = [(s, self.running[s]) for s in self._admit_order
                       if self.running.get(s) is not None
-                      and self.running[s].prefilling]
+                      and self.running[s].prefilling
+                      and not self.promoting(self.running[s])]
         if self.prefill_policy is not None and len(prefilling) > 1:
             prefilling = self.prefill_policy(prefilling)
         for slot, req in prefilling:
@@ -553,6 +561,15 @@ class ContinuousBatchingScheduler:
         non-preemptible and runs to completion while others yield."""
         return self.max_preemptions > 0 and \
             req.preemptions >= self.max_preemptions
+
+    def promoting(self, req: Request) -> bool:
+        """PROMOTING phase predicate: the request holds blocks whose
+        host-tier payloads have not landed in the pool yet.  Promotion
+        happens only on admission hits and hits never cover the full
+        prefix (the last token's logits must be computed), so a
+        promoting request is always still ``prefilling`` — the decode
+        path needs no extra gate, only :meth:`next_prefill_chunk`."""
+        return self.alloc.seq_has_pending(req.req_id)
 
     def _pick_victim(self) -> Optional[int]:
         """LIFO preemption, cache-residency-aware: walk latest-admitted

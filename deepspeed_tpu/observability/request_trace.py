@@ -202,6 +202,20 @@ class RequestTraceRecorder:
                     self._append(tl, "X", "decode", t0_ns, dur_ns,
                                  {"batch": batch})
 
+    def on_promote(self, reqs: List[Any], t0_s: float, dur_s: float,
+                   blocks: int) -> None:
+        """Host-tier promotion window: KV blocks restored from host
+        DRAM/NVMe into the device pool while the request is held in the
+        PROMOTING phase (docs/serving.md "Tiered prefix cache")."""
+        t0_ns = int(t0_s * 1e9)
+        dur_ns = int(dur_s * 1e9)
+        with self._lock:
+            for req in reqs:
+                tl = self._traces.get(req.trace_id) if req.trace_id else None
+                if tl is not None:
+                    self._append(tl, "X", "promote", t0_ns, dur_ns,
+                                 {"blocks": blocks})
+
     def on_spec(self, reqs: List[Any], t0_s: float, dur_s: float,
                 proposed: int, accepted: int) -> None:
         t0_ns = int(t0_s * 1e9)

@@ -187,6 +187,25 @@ SERVING_KV_CACHE_BITS_DEFAULT = 0
 # pre-TP path.
 SERVING_MESH_DATA_DEFAULT = 1
 SERVING_MESH_MODEL_DEFAULT = 1
+# tiered host prefix cache (docs/serving.md "Tiered prefix cache"):
+# refcount-0 blocks the pool LRU evicts spill (encoded at
+# ``wire_bits``; a quantized pool spills its own int8/int4 bytes
+# verbatim) into a host DRAM store, overflowing to an NVMe-backed store
+# when budgeted, keyed by the same chained content digest as the radix
+# index; a prefix hit on a spilled chain promotes blocks back during
+# the admission/prefill window instead of recomputing them.
+SERVING_HOST_CACHE_ENABLED_DEFAULT = False
+SERVING_HOST_CACHE_DRAM_BUDGET_BYTES_DEFAULT = 0   # 0 = DRAM tier off
+SERVING_HOST_CACHE_NVME_BUDGET_BYTES_DEFAULT = 0   # 0 = NVMe tier off
+SERVING_HOST_CACHE_NVME_PATH_DEFAULT = None        # dir for the .swp file
+# block promotions (host -> pool scatters) serviced per engine step —
+# bounds the per-iteration promote stall the decode lanes ride behind
+SERVING_HOST_CACHE_PROMOTE_PARALLELISM_DEFAULT = 4
+# wire/at-rest bits for spilling an UNQUANTIZED pool (8 = int8 with f32
+# per-row scales, 4 = packed int4, 0 = raw dtype bytes); ignored when
+# serving.kv_cache_bits already quantizes the pool (spill is then the
+# pool's own bytes, a lossless round-trip)
+SERVING_HOST_CACHE_WIRE_BITS_DEFAULT = 8
 
 # Training hot-path block (``training`` — runtime/config.py
 # TrainingConfig, docs/training_perf.md): per-run overrides of the model

@@ -368,13 +368,21 @@ class NvmeSlotStore(SlotStore):
 def make_slot_store(device: str, n_slots: int, slot_nbytes: int,
                     nvme_path: Optional[str] = None,
                     aio: Optional[AsyncIOHandle] = None,
-                    buffer_count: int = 4, name: str = "slots") -> SlotStore:
+                    buffer_count: int = 4, name: str = "slots",
+                    io_policy=None) -> SlotStore:
     """Factory keyed on the offload device enum ('cpu' → DRAM tier,
-    'nvme' → file tier)."""
+    'nvme' → file tier).  ``io_policy`` overrides the store's transient
+    retry schedule (None keeps the resilience DEFAULT_IO_POLICY) — the
+    serving host cache wires the config-derived policy through here the
+    same way InfinityStepper sets it on its stores."""
     if device == "nvme":
         if not nvme_path:
             raise ValueError("offload device=nvme requires nvme_path")
-        return NvmeSlotStore(n_slots, slot_nbytes,
-                             os.path.join(nvme_path, f"{name}.swp"),
-                             aio=aio, buffer_count=buffer_count, name=name)
-    return DramSlotStore(n_slots, slot_nbytes)
+        store: SlotStore = NvmeSlotStore(
+            n_slots, slot_nbytes, os.path.join(nvme_path, f"{name}.swp"),
+            aio=aio, buffer_count=buffer_count, name=name)
+    else:
+        store = DramSlotStore(n_slots, slot_nbytes)
+    if io_policy is not None:
+        store.io_policy = io_policy
+    return store

@@ -58,7 +58,7 @@ for f in tests/unit/test_*.py; do
   fi
   if [[ "$f" == *test_resilience.py || "$f" == *test_observability.py \
         || "$f" == *test_serving.py || "$f" == *test_serving_tp.py \
-        || "$f" == *test_frontend.py \
+        || "$f" == *test_frontend.py || "$f" == *test_host_cache.py \
         || "$f" == *test_training_perf.py ]]; then
     continue   # each runs once in its marker sweep below, not twice
   fi
@@ -128,6 +128,27 @@ if [[ -z "$FILTER" || "inference" == *"$FILTER"* || "serving" == *"$FILTER"* ]];
   fi
 fi
 
+# Tiered host-cache sweep: the `host_cache`-marked suite — wire codec
+# round trips (int8/int4 byte-exact at rest, wire_bits 0 lossless),
+# DRAM/NVMe tier LRU + ripple demotion + capacity-math pins, allocator
+# spill/promote bookkeeping (cancel restores the host entry,
+# promotion_failed rolls holders back), and the engine end-to-ends:
+# forced eviction -> host hit -> PROMOTING hold -> token-exact stream
+# vs generate(), under clean AND faulted spill/promote paths, with
+# decode_builds==1 throughout (pytest.ini `host_cache` marker;
+# docs/serving.md "Tiered prefix cache"). Includes the `slow`-marked
+# NVMe end-to-ends tier-1 skips.
+if [[ -z "$FILTER" || "host-cache" == *"$FILTER"* || "host_cache" == *"$FILTER"* \
+      || "serving" == *"$FILTER"* ]]; then
+  echo "=== host-cache marker sweep (pytest -m host_cache)"
+  if JAX_PLATFORMS=cpu python -m pytest tests/unit/test_host_cache.py \
+       -m host_cache -q --tb=short ${EXTRA_PYTEST_ARGS:-}; then
+    PASSED=$((PASSED + 1))
+  else
+    FAILED+=("pytest -m host_cache")
+  fi
+fi
+
 # Front-end sweep: the SLO multi-tenant front-end suite — greedy AND
 # seeded-sampled stream parity vs generate() (the shared
 # inference/sampling.py fold_in schedule), streaming lifecycle events,
@@ -177,6 +198,8 @@ if [[ -z "$FILTER" || "chaos" == *"$FILTER"* || "serving" == *"$FILTER"* ]]; the
     "serving.allocate=fail:1:2;serving.dispatch=fail:3:2"
     "serving.append_block=fail:2:1"
     "serving.dispatch=fail:2:3;serving.admission=fail:3:1"
+    "serving.spill=fail:1:2;serving.promote=fail:2:2"
+    "serving.spill=fatal:1:1;serving.promote=fatal:2:1"
   )
   for faults in "${CHAOS_MATRIX[@]}"; do
     echo "=== serving-chaos sweep (DSTPU_FAULTS='${faults}')"

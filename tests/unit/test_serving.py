@@ -517,8 +517,10 @@ class TestBlockAllocator:
         a.free("s2")
         a.assert_consistent()
 
-    @pytest.mark.parametrize("kv_bits", [0, 8])
-    def test_property_random_cycles_never_leak(self, kv_bits):
+    @pytest.mark.parametrize("kv_bits,host", [(0, False), (8, False),
+                                              (0, True), (8, True)])
+    def test_property_random_cycles_never_leak(self, kv_bits, host,
+                                               tmp_path):
         """Fuzz admit (with and without prefix hits)/grow/fork/free/
         commit against the invariant checker — refcounts, the hash
         index, the cached LRU and the free list must stay exactly
@@ -527,8 +529,14 @@ class TestBlockAllocator:
         the SAME HBM budget yields at bf16 vs int8 KV
         (``blocks_for_budget``): the quantized pool's extra blocks run
         the identical invariants, just with more headroom before
-        eviction pressure."""
-        from deepspeed_tpu.inference.serving import (blocks_for_budget,
+        eviction pressure.  The ``host`` variants attach a real two-tier
+        :class:`HostTierCache` (DRAM + NVMe, deliberately tiny so
+        entries demote and age out) and interleave spill / promote-land
+        / promote-fail / cancel-by-free / re-hit with the device ops —
+        ``assert_consistent`` additionally checks the cross-tier
+        invariant that a digest is never resident in two places."""
+        from deepspeed_tpu.inference.serving import (HostTierCache,
+                                                     blocks_for_budget,
                                                      kv_block_bytes)
         rng = np.random.default_rng(0)
         budget = 24 * kv_block_bytes(4, 4, 32)       # 24 bf16 blocks
@@ -536,15 +544,27 @@ class TestBlockAllocator:
         if kv_bits:
             assert nb > 24 * 1.5, "int8 sizing lost its capacity win"
         a = PagedBlockAllocator(num_blocks=nb, block_size=4)
+        hc = None
+        if host:
+            hc = HostTierCache(64, dram_slots=6, nvme_slots=8,
+                               nvme_path=str(tmp_path))
+            # stand-in for the engine's gather+encode: a synthetic
+            # 64-byte payload derived from the digest (content fidelity
+            # is the engine e2e tests' job; this fuzz owns bookkeeping)
+            a.attach_host_tier(
+                hc, lambda b, h: hc.put(h, np.frombuffer(
+                    (h * 4)[:64], np.uint8)))
         # a small universe of shared "prompts" so hits actually happen
         prompts = [list(rng.integers(0, 50, n)) for n in (8, 12, 20, 9)]
         live, counter, hits = {}, 0, 0
         # keep eviction pressure comparable across pool sizes: the
         # int8-budget pool holds ~2x the blocks, so allocations scale up
         max_tok = 30 * nb // 24
+        ops = ["alloc", "alloc_cached", "grow", "free", "fork", "commit"]
+        if host:
+            ops += ["promote_land", "promote_fail"]
         for step in range(600):
-            op = rng.choice(["alloc", "alloc_cached", "grow", "free",
-                             "fork", "commit"])
+            op = rng.choice(ops)
             try:
                 if op == "alloc":
                     sid = f"s{counter}"
@@ -565,6 +585,9 @@ class TestBlockAllocator:
                     t, ids = live[sid]
                     live[sid] = (t + a.block_size, ids)
                 elif op == "free" and live:
+                    # freeing a PROMOTING holder exercises the cancel
+                    # path: pending blocks return to the raw free list
+                    # and their payloads go back to the host tier
                     sid = rng.choice(sorted(live))
                     a.free(sid)
                     del live[sid]
@@ -579,15 +602,27 @@ class TestBlockAllocator:
                     t, ids = live[sid]
                     if ids is not None:
                         a.commit_cached(sid, ids, min(t, len(ids)))
+                elif op == "promote_land" and a.num_pending:
+                    a.promotion_landed(a.pending_jobs()[0].digest)
+                elif op == "promote_fail" and a.num_pending:
+                    # fatal promote: registration dropped, holders roll
+                    # back to recompute (tracked scheduler-side)
+                    a.promotion_failed(a.pending_jobs()[0].digest)
             except BlockPoolError:
                 pass                           # exhaustion is legal; leaks are not
             a.assert_consistent()
+        if host:
+            assert hc.spills_total > 0 and a.host_hit_tokens_total > 0, \
+                "fuzz never exercised the host tier: tune the universe"
         assert hits > 0 and a.evictions_total > 0, \
             "fuzz never exercised the cache: tune the universe"
         for sid in list(live):
             a.free(sid)
         a.assert_consistent()
         assert a.num_free == a.usable_blocks
+        if hc is not None:
+            hc.assert_consistent(set())
+            hc.close()
 
 
 # ---------------------------------------------------------------------------

@@ -8,10 +8,13 @@ token-identically to sequential ``generate()``.
 
 Runs standalone AND under the ``run_tests.sh`` serving-chaos stage,
 which replays it across a ``DSTPU_FAULTS`` env matrix (transient-only
-plans on the ``serving.*`` sites): the fixture builds the injector FROM
-the environment, so each matrix entry is the same workload under a
-different fault schedule.  docs/serving.md "Failure handling &
-overload" describes the semantics being pinned.
+plans on the scheduling sites, transient AND fatal plans on the tiered
+host-cache sites ``serving.spill`` / ``serving.promote``, whose fatal
+handling is defined to degrade — eviction instead of spill, recompute
+instead of promote — never to fail a request): the fixture builds the
+injector FROM the environment, so each matrix entry is the same
+workload under a different fault schedule.  docs/serving.md "Failure
+handling & overload" describes the semantics being pinned.
 """
 import numpy as np
 import pytest
@@ -48,7 +51,14 @@ def chaos_engine(num_kv_blocks=16, slots=3, max_queue_depth=16,
                "prefill_chunk_tokens": 8,
                "max_preemptions": 4,
                "max_queue_depth": max_queue_depth,
-               "kv_cache_bits": kv_cache_bits}
+               "kv_cache_bits": kv_cache_bits,
+               # host tier ON under chaos so the serving.spill /
+               # serving.promote matrix entries bite; wire_bits 0 keeps
+               # the raw-f32 pool's spill/promote LOSSLESS — OK streams
+               # must stay token-exact whatever the fault schedule
+               "host_cache": {"enabled": True,
+                              "dram_budget_bytes": 1 << 20,
+                              "wire_bits": 0}}
     if spec_k is not None:
         serving["spec_k"] = spec_k
     eng = ds.init_inference(TransformerLM(cfg), config={
