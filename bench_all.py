@@ -1048,6 +1048,129 @@ def multi_tenant_replay_bench(slots: int = 4, new: int = 16,
         "decode_builds": srv.decode_builds}), flush=True)
 
 
+def fleet_failover_bench(replicas: int = 2, rounds: int = 12,
+                         new: int = 12, kill_at: int = 9, **model_kw):
+    """Price the fleet failover path (docs/serving.md "Fleet serving &
+    failover"): the same two-tenant wave runs twice across the replica
+    fleet — once clean, once with a fatal ``serving.fleet.replica_step``
+    killing one replica at a fixed site-call index mid-wave.  Reports
+    the failover detection latency (kill -> first replayed token
+    delivered past the dedup high-water mark), the replayed-token
+    overhead the dedup swallowed, per-tenant p99 TTFT with vs without
+    the kill, and ``decode_builds`` (must stay 1 per surviving replica
+    — failover replays ride the existing compiled step, never a
+    retrace).  Absolute latencies are only meaningful on TPU."""
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.inference.serving import FleetRouter, ReplicaState
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+    from deepspeed_tpu.runtime.resilience import (FaultInjector,
+                                                  install_fault_injector)
+
+    cfg = gpt2_config("125m", dtype=jnp.float32, **model_kw)
+    tenants = ("interactive", "batch")
+
+    def run(kill: bool):
+        eng = ds.init_inference(TransformerLM(cfg), config={
+            "dtype": "float32", "max_out_tokens": 64,
+            "temperature": 0.0, "replace_with_kernel_inject": False,
+            "serving": {"enabled": True, "kv_block_size": 8,
+                        "num_kv_blocks": 64, "max_batch_slots": 4,
+                        "prefill_chunk_tokens": 32,
+                        "max_queue_depth": 32,
+                        "fleet": {"enabled": True,
+                                  "replicas": replicas}}})
+        fleet = FleetRouter.from_engine(eng, rng=jax.random.PRNGKey(0))
+        # warm every replica's compile before the clock (and before the
+        # injector: warmup steps must not consume the kill index)
+        for _ in range(replicas):
+            fleet.submit([1, 2, 3], max_new_tokens=4)
+        fleet.run()
+        t_kill = {}
+        for r in fleet.replicas:
+            orig = r.mark_dead
+            def dead(reason, _orig=orig):
+                t_kill.setdefault("t", time.perf_counter())
+                _orig(reason)
+            r.mark_dead = dead
+        fi = FaultInjector()
+        if kill:
+            fi.add_plan("serving.fleet.replica_step", "fatal",
+                        at=kill_at)
+        install_fault_injector(fi)
+        try:
+            rs = np.random.RandomState(11)
+            ttft = {t: [] for t in tenants}
+            first_replay = {}
+
+            def hook(freq):
+                def _cb(ev):
+                    if ev.token is None:
+                        return
+                    if ev.index == 0:
+                        ttft[ev.tenant].append(
+                            ev.time_s - freq.submit_time)
+                    if "t" in t_kill and freq.failovers:
+                        first_replay.setdefault(
+                            freq.req_id, time.perf_counter())
+                return _cb
+
+            reqs = []
+            t0 = time.perf_counter()
+            for i in range(rounds):
+                plen = int(rs.randint(4, 9)) if i % 2 == 0 \
+                    else int(rs.randint(16, 21))
+                tenant = tenants[i % 2]
+                p = rs.randint(0, cfg.vocab_size, (plen,)).tolist()
+                freq = fleet.submit(p, max_new_tokens=new,
+                                    tenant=tenant)
+                freq.on_token = hook(freq)
+                reqs.append(freq)
+                fleet.pump()
+            fleet.run()
+            dt = time.perf_counter() - t0
+            assert all(r.status is not None and r.status.value == "ok"
+                       for r in reqs), "a request did not survive"
+            dead = [r.replica_id for r in fleet.replicas
+                    if r.state is ReplicaState.DEAD]
+            detect_ms = None
+            if "t" in t_kill and first_replay:
+                detect_ms = round(
+                    (min(first_replay.values()) - t_kill["t"]) * 1e3, 2)
+            return {
+                "tokens_per_sec": round(
+                    sum(len(r.output) for r in reqs) / dt, 1),
+                "ttft_p99_ms": {
+                    t: round(float(np.percentile(ttft[t], 99)) * 1e3, 2)
+                    for t in tenants if ttft[t]},
+                "dead_replicas": dead,
+                "failovers": fleet.fleet_counts["failovers"],
+                "replayed_tokens": fleet.fleet_counts["replayed_tokens"],
+                "failover_detect_ms": detect_ms,
+                "decode_builds": [r.srv.decode_builds
+                                  for r in fleet.replicas]}
+        finally:
+            install_fault_injector(FaultInjector())
+
+    base = run(kill=False)
+    killed = run(kill=True)
+    assert all(b == 1 for b in killed["decode_builds"]), \
+        "failover replay retraced a surviving replica"
+    print(json.dumps({
+        "metric": "fleet_failover",
+        "value": killed["failover_detect_ms"], "unit": "ms",
+        "replicas": replicas, "kill_at": kill_at,
+        "dead_replica": (killed["dead_replicas"] or [None])[0],
+        "failovers": killed["failovers"],
+        "replayed_tokens": killed["replayed_tokens"],
+        "tokens_per_sec": {"baseline": base["tokens_per_sec"],
+                           "kill": killed["tokens_per_sec"]},
+        "ttft_p99_ms": {"baseline": base["ttft_p99_ms"],
+                        "kill": killed["ttft_p99_ms"]},
+        "decode_builds": killed["decode_builds"]}), flush=True)
+
+
 def main():
     import jax
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -1060,6 +1183,7 @@ def main():
         decode16k_bench(hbm_gbps=hbm)
         serving_decode_bench()
         multi_tenant_replay_bench(spec_k=3)
+        fleet_failover_bench()
         prefix_cache_bench()
         tiered_prefix_cache_bench()
         paged_decode_attention_bench()
@@ -1078,6 +1202,10 @@ def main():
         tp_decode_bench()
         multi_tenant_replay_bench(num_layers=2, d_model=64, num_heads=4,
                                   vocab_size=256, max_seq_len=128)
+        # failover pricing on the same tiny model: the detection/replay
+        # numbers rank the path's overheads, not TPU latency
+        fleet_failover_bench(num_layers=2, d_model=64, num_heads=4,
+                             vocab_size=256, max_seq_len=128)
         # tiny-model tier sweep: exercises spill -> host -> promote on
         # the interpret-mode kernels; ratios are indicative only on CPU
         import jax.numpy as jnp

@@ -4,8 +4,10 @@ from .elasticity import (ElasticityError, ElasticityIncompatibleWorldSize,
                          compute_elastic_config)
 from .rendezvous import (ClusterAgentResult, ClusterElasticAgent,
                          FileRendezvous)
+from .serving_fleet import ReplicaLivenessMonitor
 
 __all__ = ["AgentResult", "ElasticAgent", "WorkerSpec",
            "compute_elastic_config", "ElasticityError",
            "ElasticityIncompatibleWorldSize", "ClusterAgentResult",
-           "ClusterElasticAgent", "FileRendezvous"]
+           "ClusterElasticAgent", "FileRendezvous",
+           "ReplicaLivenessMonitor"]

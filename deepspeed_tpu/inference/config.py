@@ -91,6 +91,65 @@ class HostCacheConfig(ConfigModel):
         return self
 
 
+class FleetConfig(ConfigModel):
+    """``serving.fleet`` block — the resilient serving fleet
+    (`inference/serving/fleet/`, docs/serving.md "Fleet serving &
+    failover").
+
+    With ``enabled``, ``replicas`` independent ``ServingEngine``s sit
+    behind a ``FleetRouter`` that places each request on the replica
+    whose radix/host-tier digests cover the longest prompt prefix,
+    traded against queue depth.  A replica that raises ``ServingError``,
+    hits an injected fatal, or (threaded) misses heartbeats past
+    ``heartbeat_timeout_s`` is declared DEAD and every in-flight request
+    is replayed on a healthy replica with its original fold_in key —
+    the resumed stream is bit-identical and the router's high-water
+    deduplicator delivers each token exactly once."""
+    enabled: bool = C.SERVING_FLEET_ENABLED_DEFAULT
+    replicas: int = C.SERVING_FLEET_REPLICAS_DEFAULT
+    heartbeat_interval_s: float = \
+        C.SERVING_FLEET_HEARTBEAT_INTERVAL_S_DEFAULT
+    heartbeat_timeout_s: float = \
+        C.SERVING_FLEET_HEARTBEAT_TIMEOUT_S_DEFAULT
+    affinity_weight: float = C.SERVING_FLEET_AFFINITY_WEIGHT_DEFAULT
+    max_failovers: int = C.SERVING_FLEET_MAX_FAILOVERS_DEFAULT
+    retry_base_delay_s: float = C.SERVING_FLEET_RETRY_BASE_DELAY_S_DEFAULT
+    retry_max_delay_s: float = C.SERVING_FLEET_RETRY_MAX_DELAY_S_DEFAULT
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.replicas < 1:
+            raise ValueError(
+                f"serving.fleet.replicas must be >= 1, got "
+                f"{self.replicas}")
+        if self.heartbeat_interval_s <= 0:
+            raise ValueError(
+                f"serving.fleet.heartbeat_interval_s must be > 0, got "
+                f"{self.heartbeat_interval_s}")
+        if (self.heartbeat_timeout_s
+                and self.heartbeat_timeout_s
+                < 2 * self.heartbeat_interval_s):
+            # same rule as the training watchdog: a timeout tighter than
+            # two beats declares healthy replicas dead
+            raise ValueError(
+                f"serving.fleet.heartbeat_timeout_s must be 0 or >= 2x "
+                f"heartbeat_interval_s, got {self.heartbeat_timeout_s}")
+        if self.affinity_weight < 0:
+            raise ValueError(
+                f"serving.fleet.affinity_weight must be >= 0, got "
+                f"{self.affinity_weight}")
+        if self.max_failovers < 0:
+            raise ValueError(
+                f"serving.fleet.max_failovers must be >= 0, got "
+                f"{self.max_failovers}")
+        if self.retry_base_delay_s <= 0 \
+                or self.retry_max_delay_s < self.retry_base_delay_s:
+            raise ValueError(
+                "serving.fleet retry delays must satisfy "
+                "0 < retry_base_delay_s <= retry_max_delay_s")
+        return self
+
+
 class ServingConfig(ConfigModel):
     """``serving`` block — continuous-batching inference
     (`inference/serving/`, docs/serving.md).
@@ -155,6 +214,9 @@ class ServingConfig(ConfigModel):
     # tiered host prefix cache: spill LRU-evicted blocks to host
     # DRAM/NVMe and promote on hit — see HostCacheConfig
     host_cache: HostCacheConfig = Field(default_factory=HostCacheConfig)
+    # resilient replica fleet: router + health-checked replicas with
+    # token-exact failover — see FleetConfig
+    fleet: FleetConfig = Field(default_factory=FleetConfig)
 
     @model_validator(mode="after")
     def _validate(self):

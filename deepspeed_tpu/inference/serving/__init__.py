@@ -13,7 +13,11 @@ Three layers, composed by ``InferenceEngine.serving_engine()``:
     speculative-decoding draft lane;
   * :mod:`frontend` — the SLO-grade multi-tenant front-end
     (:class:`ServingFrontend`): weighted-fair admission / prefill /
-    shed policies plus per-tenant metrics.
+    shed policies plus per-tenant metrics;
+  * :mod:`fleet` — the resilient replica fleet (:class:`FleetRouter` +
+    :class:`ReplicaHandle`): health-checked replicas, prefix-affinity
+    placement, token-exact failover with exactly-once delivery, live
+    drain/join.
 """
 from ...observability.slo import SloAlert, SloMonitor  # noqa: F401
 from ...runtime.resilience.errors import ServingError  # noqa: F401
@@ -21,8 +25,11 @@ from .block_allocator import (BlockPoolError, NULL_BLOCK,  # noqa: F401
                               PagedBlockAllocator, blocks_for_budget,
                               kv_block_bytes)
 from .engine import ServingEngine  # noqa: F401
+from .fleet import (FleetRequest, FleetRouter,  # noqa: F401
+                    ReplicaHandle, ReplicaState, placement_score)
 from .frontend import (ServingFrontend, StreamCollector,  # noqa: F401
-                       TokenEvent, TenantRegistry, TenantSpec)
+                       StreamDeduper, TokenEvent, TenantRegistry,
+                       TenantSpec)
 from .host_cache import (BlockCodec, HostTierCache,  # noqa: F401
                          host_block_bytes, tiered_blocks_for_budget)
 from .scheduler import (ContinuousBatchingScheduler, Request,  # noqa: F401
@@ -30,10 +37,11 @@ from .scheduler import (ContinuousBatchingScheduler, Request,  # noqa: F401
 
 __all__ = ["BlockCodec", "BlockPoolError", "NULL_BLOCK",
            "PagedBlockAllocator",
-           "ContinuousBatchingScheduler", "HostTierCache", "Request",
+           "ContinuousBatchingScheduler", "FleetRequest", "FleetRouter",
+           "HostTierCache", "ReplicaHandle", "ReplicaState", "Request",
            "RequestState", "RequestStatus", "ServingEngine",
            "ServingError", "ServingFrontend", "SloAlert", "SloMonitor",
-           "StreamCollector", "TokenEvent",
+           "StreamCollector", "StreamDeduper", "TokenEvent",
            "TenantRegistry", "TenantSpec",
            "host_block_bytes", "kv_block_bytes", "blocks_for_budget",
-           "tiered_blocks_for_budget"]
+           "placement_score", "tiered_blocks_for_budget"]

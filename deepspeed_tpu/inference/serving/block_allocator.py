@@ -457,6 +457,29 @@ class PagedBlockAllocator:
                 live_hits += 1
         return need - live_hits
 
+    def probe_prefix_coverage(self, token_ids: Sequence[int]) -> int:
+        """READ-ONLY affinity probe for the fleet router: how many
+        leading tokens of ``token_ids`` this pool (device radix index
+        OR attached host tier) already covers, walking the same chained
+        content digests :meth:`allocate`'s hit walk uses and stopping at
+        the first miss.  Mutates nothing — no claims, no LRU touches,
+        no promotions — so the router may probe every replica per
+        placement decision (docs/serving.md "Fleet serving &
+        failover")."""
+        if not self.enable_prefix_cache or not token_ids:
+            return 0
+        bs = self.block_size
+        max_hit_blocks = max(0, (len(token_ids) - 1) // bs)
+        h, covered = ROOT_HASH, 0
+        for i in range(max_hit_blocks):
+            h = _chain_hash(h, tuple(token_ids[i * bs:(i + 1) * bs]))
+            if h in self._hash_to_block or (
+                    self._host is not None and self._host.contains(h)):
+                covered += 1
+            else:
+                break
+        return covered * bs
+
     def append_block(self, seq_id: str) -> int:
         """Grow a sequence by one block (decode crossed a block
         boundary); raises on exhaustion — the scheduler preempts and

@@ -57,6 +57,7 @@ from ...parallel.shard_map_compat import shard_map
 from ...runtime.resilience.errors import (FatalIOError, ServingError,
                                           TransientIOError)
 from ...runtime.resilience.fault_injection import get_fault_injector
+from ...runtime.resilience.heartbeat import Heartbeat
 from ...runtime.resilience.retry import retry_call
 from ...utils.logging import logger
 from ..sampling import fold_in_keys, sample_tokens_per_row
@@ -64,7 +65,8 @@ from .block_allocator import PagedBlockAllocator
 from .host_cache import BlockCodec, HostTierCache
 from .frontend.streaming import TokenEvent
 from .scheduler import (ContinuousBatchingScheduler, Request,
-                        RequestState, RequestStatus)
+                        RequestState, RequestStatus,
+                        estimate_retry_after_s)
 
 
 def _tp_qkv_perm(nh: int, nkv: int, hd: int, mp: int) -> np.ndarray:
@@ -120,7 +122,8 @@ class ServingEngine:
     """
 
     def __init__(self, engine, rng: Optional[jax.Array] = None,
-                 draft_model=None, draft_params=None):
+                 draft_model=None, draft_params=None,
+                 shared_host_cache: Optional[HostTierCache] = None):
         cfg = engine.config.serving
         model = engine.module
         reason = model._paged_supported()
@@ -142,6 +145,9 @@ class ServingEngine:
             self.num_slots, self.allocator, self.max_pages,
             max_queue_depth=cfg.max_queue_depth,
             max_preemptions=cfg.max_preemptions)
+        # SHED terminals advertise a drain-rate-derived Retry-After
+        # (docs/serving.md "Fleet serving & failover")
+        self.scheduler.retry_after_hint = self._estimate_retry_after
         self.no_progress_steps = cfg.no_progress_steps
         self.default_deadline_s = cfg.default_deadline_s
         #: KV-cache width: 0 = engine dtype, 8 = int8, 4 = packed int4
@@ -224,7 +230,13 @@ class ServingEngine:
                     "serving.host_cache.enabled requires "
                     "serving.prefix_cache — the host tier is keyed by "
                     "the radix index's content digests")
-            self._init_host_cache(cfg.host_cache)
+            # ``shared_host_cache`` is the fleet's cross-replica warm
+            # tier: the store is content-addressed and device-agnostic,
+            # so replicas sharing one instance hit prefixes their
+            # siblings spilled — and a joining replica starts warm
+            # (docs/serving.md "Fleet serving & failover")
+            self._init_host_cache(cfg.host_cache,
+                                  shared=shared_host_cache)
 
         self.temperature = engine.config.temperature
         self.top_k = engine.config.top_k
@@ -257,6 +269,19 @@ class ServingEngine:
         self.token_hooks: List[Callable] = []
         self.lifecycle_hooks: List[Callable] = []
         self._event_buf: List[TokenEvent] = []
+
+        #: liveness beat stamped at every iteration boundary so a
+        #: serving process under the elastic agent (or a fleet replica
+        #: thread) never looks hung while it is making progress.
+        #: Defaults to the agent's ``DSTPU_HEARTBEAT_FILE`` env
+        #: contract — a no-op outside an agent; the fleet's
+        #: ``ReplicaHandle`` swaps in a per-replica file.
+        self.heartbeat = Heartbeat(
+            interval_s=cfg.fleet.heartbeat_interval_s)
+        # drain-rate EMA feeding the SHED retry_after_s hint: seconds
+        # per finished request, updated at each iteration boundary
+        self._drain_rate_ema: Optional[float] = None
+        self._last_finish_t: Optional[float] = None
 
         reg = get_registry()
         self._m_queue = reg.gauge(
@@ -539,13 +564,16 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # tiered host prefix cache (docs/serving.md "Tiered prefix cache")
     # ------------------------------------------------------------------
-    def _init_host_cache(self, hc) -> None:
+    def _init_host_cache(self, hc, shared=None) -> None:
         """Build the host tier from the pool geometry and wire it into
         the allocator: eviction becomes demotion (``_spill_block``),
         and the allocate hit walk extends into the host store.  The
         gather/scatter helper programs are compiled HERE, off the
         serving clock, by round-tripping the null block — the mixed
-        step stays the one program (``decode_builds`` untouched)."""
+        step stays the one program (``decode_builds`` untouched).
+        ``shared`` injects an already-built (fleet-shared) store
+        instead: entry geometry must match, budgets were sized by
+        whoever built it."""
         c = self.model.config
         self._hc_codec = BlockCodec(
             c.num_layers, self.block_size, c.kv_heads, c.hdim,
@@ -553,6 +581,18 @@ class ServingEngine:
             dtype=np.dtype(self._pool_k.dtype) if not self.kv_bits
             else np.int8)
         entry = self._hc_codec.nbytes
+        if shared is not None:
+            if shared.entry_nbytes != entry:
+                raise ValueError(
+                    f"shared host cache entry size "
+                    f"{shared.entry_nbytes} != this replica's codec "
+                    f"{entry} bytes — fleet replicas must share pool "
+                    f"geometry (block size, kv heads, bits)")
+            self.host_cache = shared
+            self.allocator.attach_host_tier(self.host_cache,
+                                            self._spill_block)
+            self._build_block_dma()
+            return
         dram_slots = hc.dram_budget_bytes // entry
         nvme_slots = hc.nvme_budget_bytes // entry
         if dram_slots == 0 and nvme_slots == 0:
@@ -567,6 +607,15 @@ class ServingEngine:
             buffer_count=max(4, self._promote_k))
         self.allocator.attach_host_tier(self.host_cache,
                                         self._spill_block)
+        self._build_block_dma()
+        logger.info(
+            f"serving: tiered host cache on — entry {entry / 2**10:.1f} "
+            f"KiB at {self._hc_codec.at_rest_bits or 'raw'}-bit, "
+            f"dram {dram_slots} entries"
+            f"{f', nvme {nvme_slots} entries' if nvme_slots else ''}, "
+            f"promote parallelism {self._promote_k}")
+
+    def _build_block_dma(self) -> None:
         # block-granular DMA helpers: tiny jitted gather/scatter over
         # the pools (NOT the mixed step — these run in the admission
         # window, never per decode token)
@@ -601,12 +650,6 @@ class ServingEngine:
             k, v = self._gather_block(self._pool_k, self._pool_v, b0)
             self._pool_k, self._pool_v = self._scatter_block(
                 self._pool_k, self._pool_v, b0, k, v)
-        logger.info(
-            f"serving: tiered host cache on — entry {entry / 2**10:.1f} "
-            f"KiB at {self._hc_codec.at_rest_bits or 'raw'}-bit, "
-            f"dram {dram_slots} entries"
-            f"{f', nvme {nvme_slots} entries' if nvme_slots else ''}, "
-            f"promote parallelism {self._promote_k}")
 
     def _spill_block(self, block: int, digest: bytes) -> None:
         """Allocator eviction callback: encode the dying block and park
@@ -1392,7 +1435,12 @@ class ServingEngine:
         consecutive iterations that moved nothing (no tokens, no prefill
         chunks, no terminal transitions) while work remained."""
         try:
-            return self._step_impl()
+            result = self._step_impl()
+            # iteration boundary reached with the loop alive: stamp the
+            # liveness beat the elastic agent / fleet watchdog reads
+            # (rate-limited inside maybe_beat)
+            self.heartbeat.maybe_beat()
+            return result
         except ServingError as e:
             # black-box flight recorder: seal the post-mortem bundle
             # (snapshot ring + terminals + metrics + trace) before the
@@ -1484,6 +1532,7 @@ class ServingEngine:
         # Preemptions deliberately do not — a preemption-only iteration
         # is exactly the livelock signature the watchdog exists for.
         progress += len(sched.finished) - finished_before
+        self._update_drain_rate(len(sched.finished) - finished_before)
         if progress or not sched.has_work:
             self._no_progress = 0
         else:
@@ -1496,6 +1545,22 @@ class ServingEngine:
                     f"zero terminal transitions) — scheduler wedged or "
                     f"every dispatch faulted"))
         return sched.has_work
+
+    def _update_drain_rate(self, n_finished: int) -> None:
+        """EMA of wall seconds per FINISHED request, fed by every
+        iteration boundary — the drain rate behind the SHED
+        ``retry_after_s`` hint."""
+        if n_finished <= 0:
+            return
+        now = time.perf_counter()
+        if self._last_finish_t is not None:
+            per = (now - self._last_finish_t) / n_finished
+            self._drain_rate_ema = per if self._drain_rate_ema is None \
+                else 0.7 * self._drain_rate_ema + 0.3 * per
+        self._last_finish_t = now
+
+    def _estimate_retry_after(self) -> float:
+        return estimate_retry_after_s(self._drain_rate_ema)
 
     def _flight_snapshot(self) -> dict:
         """One flight-recorder frame: the engine state an operator needs

@@ -13,8 +13,10 @@ Three composable pieces over the serving engine:
     the per-tenant ``dstpu_serving_tenant_*`` metrics.
 """
 from .frontend import ServingFrontend  # noqa: F401
-from .streaming import StreamCollector, TokenEvent  # noqa: F401
+from .streaming import (StreamCollector, StreamDeduper,  # noqa: F401
+                        StreamReplayError, TokenEvent)
 from .tenancy import TenantRegistry, TenantSpec  # noqa: F401
 
-__all__ = ["ServingFrontend", "StreamCollector", "TokenEvent",
+__all__ = ["ServingFrontend", "StreamCollector", "StreamDeduper",
+           "StreamReplayError", "TokenEvent",
            "TenantRegistry", "TenantSpec"]

@@ -38,6 +38,55 @@ class TokenEvent(NamedTuple):
     prev_time_s: Optional[float]
 
 
+class StreamReplayError(RuntimeError):
+    """A replayed stream diverged from what was already delivered —
+    the fold-in key schedule's bit-identical replay contract was
+    violated (wrong key on resubmit, or a non-deterministic sampler)."""
+
+
+class StreamDeduper:
+    """Fleet-level exactly-once filter over a (possibly replayed)
+    token stream (docs/serving.md "Fleet serving & failover").
+
+    Token-exact failover resubmits a dead replica's request from token
+    0 — the fold-in key schedule makes the replayed stream bit-identical
+    — so the client-facing stream must forward only tokens past the
+    high-water mark already delivered.  ``admit`` returns the event to
+    forward, or None for a replayed duplicate (counted in
+    ``duplicates``); a duplicate whose token differs from what was
+    delivered at that index raises :class:`StreamReplayError` — better
+    a loud failover bug than a silently forked stream.  Tokenless
+    terminal events pass through untouched (they carry no index to
+    deduplicate)."""
+
+    def __init__(self) -> None:
+        self.delivered: List[int] = []
+        self.duplicates = 0
+
+    @property
+    def high_water(self) -> int:
+        """Number of tokens already forwarded to the client."""
+        return len(self.delivered)
+
+    def admit(self, ev: TokenEvent) -> Optional[TokenEvent]:
+        if ev.token is None:
+            return ev
+        if ev.index < len(self.delivered):
+            self.duplicates += 1
+            if self.delivered[ev.index] != ev.token:
+                raise StreamReplayError(
+                    f"replayed stream diverged at index {ev.index}: "
+                    f"delivered {self.delivered[ev.index]}, replay "
+                    f"emitted {ev.token}")
+            return None
+        if ev.index > len(self.delivered):
+            raise StreamReplayError(
+                f"stream gap: expected index {len(self.delivered)}, "
+                f"got {ev.index}")
+        self.delivered.append(ev.token)
+        return ev
+
+
 class StreamCollector:
     """Minimal ``on_token`` sink: records tokens and events in arrival
     order (tests and the replay bench read ``tokens`` / ``events``

@@ -162,6 +162,11 @@ class Request:
     #: assigned at submit when request tracing is enabled, doubles as
     #: the TTFT/ITL histogram exemplar; None while tracing is off
     trace_id: Optional[str] = None
+    #: SHED back-pressure hint: seconds the caller should wait before
+    #: resubmitting, derived from the queue's current drain rate (the
+    #: serving 503's Retry-After header).  None on every other terminal
+    #: status, and on sheds before the engine has a rate estimate.
+    retry_after_s: Optional[float] = None
 
     @property
     def prefix(self) -> List[int]:
@@ -181,6 +186,24 @@ class Request:
             return True
         return (self.eos_token_id is not None and bool(self.output)
                 and self.output[-1] == self.eos_token_id)
+
+
+def estimate_retry_after_s(seconds_per_finish: Optional[float],
+                           floor_s: float = 0.05,
+                           cap_s: float = 30.0) -> float:
+    """Pure retry-after estimator behind the SHED hint: a bounded queue
+    opens one position per admission, and admissions follow finishes —
+    so at the current drain rate (``seconds_per_finish``, an EMA of
+    wall seconds per FINISHED request) a shed caller should come back
+    after about one drain interval.  Floored so a hint never says
+    "now", capped so a stalled queue's estimate stays a backoff rather
+    than a farewell; with no rate yet (nothing has finished), returns
+    the floor.  Contention between simultaneously-shed callers is the
+    router's problem: it jitters this hint through the retry_call
+    backoff schedule (docs/serving.md "Fleet serving & failover")."""
+    if seconds_per_finish is None or seconds_per_finish <= 0:
+        return floor_s
+    return float(min(cap_s, max(floor_s, seconds_per_finish)))
 
 
 class ContinuousBatchingScheduler:
@@ -226,6 +249,11 @@ class ContinuousBatchingScheduler:
         #: to shed in the incoming request's place (None / the incoming
         #: request itself = shed the incoming, the legacy behavior)
         self.shed_policy: Optional[Callable] = None
+        #: fn() -> Optional[float] — installed by the engine: the
+        #: drain-rate-derived wait a SHED terminal should advertise via
+        #: ``Request.retry_after_s`` (docs/serving.md "Fleet serving &
+        #: failover"); None = no hint stamped
+        self.retry_after_hint: Optional[Callable] = None
 
     # -- introspection -----------------------------------------------------
     @property
@@ -310,6 +338,11 @@ class ContinuousBatchingScheduler:
         req.state = RequestState.FINISHED
         req.status = req.status or status
         req.error = error
+        if (status is RequestStatus.SHED and req.retry_after_s is None
+                and self.retry_after_hint is not None):
+            # both shed paths (bounded backpressure and the fairness
+            # victim) funnel here, so every SHED carries the hint
+            req.retry_after_s = self.retry_after_hint()
         req.finish_time = time.perf_counter()
         self.finished.append(req)
         if status is not RequestStatus.OK:

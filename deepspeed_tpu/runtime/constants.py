@@ -206,6 +206,31 @@ SERVING_HOST_CACHE_PROMOTE_PARALLELISM_DEFAULT = 4
 # serving.kv_cache_bits already quantizes the pool (spill is then the
 # pool's own bytes, a lossless round-trip)
 SERVING_HOST_CACHE_WIRE_BITS_DEFAULT = 8
+# Resilient serving fleet (``serving.fleet`` — inference/serving/fleet/,
+# docs/serving.md "Fleet serving & failover"): many ServingEngine
+# replicas behind a router that places by queue depth and cached-prefix
+# affinity, declares replicas dead on missed heartbeats / ServingError,
+# and replays every in-flight request on a healthy replica with its
+# original fold_in key — the stream is bit-identical and a high-water
+# deduplicator makes delivery exactly-once.
+SERVING_FLEET_ENABLED_DEFAULT = False
+SERVING_FLEET_REPLICAS_DEFAULT = 2          # engines behind the router
+# heartbeat stamped at every serving iteration boundary; a replica whose
+# beat file goes stale past the timeout is declared DEAD (threaded
+# replicas only — cooperative stepping surfaces death synchronously)
+SERVING_FLEET_HEARTBEAT_INTERVAL_S_DEFAULT = 1.0
+SERVING_FLEET_HEARTBEAT_TIMEOUT_S_DEFAULT = 0.0    # 0 disables staleness
+# placement score = affinity_weight * covered-prefix tokens - queue cost
+# per waiting request; higher weight chases warm prefixes harder at the
+# price of queue imbalance
+SERVING_FLEET_AFFINITY_WEIGHT_DEFAULT = 1.0
+# failover attempts per request before the fleet gives up and FAILs it
+# (each resubmission replays the original key — token-exact)
+SERVING_FLEET_MAX_FAILOVERS_DEFAULT = 3
+# jittered backoff for honoring SHED retry_after_s hints when every
+# routable replica is saturated (retry_call-shaped schedule)
+SERVING_FLEET_RETRY_BASE_DELAY_S_DEFAULT = 0.05
+SERVING_FLEET_RETRY_MAX_DELAY_S_DEFAULT = 2.0
 
 # Training hot-path block (``training`` — runtime/config.py
 # TrainingConfig, docs/training_perf.md): per-run overrides of the model

@@ -16,6 +16,10 @@ instrumented code paths:
     serving.append_block   one paged-KV block-table growth (decode boundary)
     serving.admission      one serving-scheduler admission attempt
     serving.dispatch       one mixed-step program dispatch
+    serving.spill          one eviction demoted into the host tier
+    serving.promote        one host-tier block scatter back to the pool
+    serving.fleet.route    one fleet placement decision
+    serving.fleet.replica_step  one fleet replica's engine iteration
 
 The serving sites feed the continuous-batching chaos suite
 (tests/unit/test_serving_chaos.py, docs/serving.md "Failure handling"):

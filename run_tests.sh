@@ -59,6 +59,7 @@ for f in tests/unit/test_*.py; do
   if [[ "$f" == *test_resilience.py || "$f" == *test_observability.py \
         || "$f" == *test_serving.py || "$f" == *test_serving_tp.py \
         || "$f" == *test_frontend.py || "$f" == *test_host_cache.py \
+        || "$f" == *test_fleet.py \
         || "$f" == *test_training_perf.py ]]; then
     continue   # each runs once in its marker sweep below, not twice
   fi
@@ -165,6 +166,42 @@ if [[ -z "$FILTER" || "frontend" == *"$FILTER"* || "serving" == *"$FILTER"* ]]; 
   else
     FAILED+=("pytest -m frontend")
   fi
+fi
+
+# Fleet sweep: the resilient-serving-fleet suite — placement / dedup /
+# retry-after / config units, stub-router placement + shed-backoff
+# units, and the engine end-to-ends: mixed greedy+seeded wave parity
+# across replicas, the token-exact failover acceptance (fatal
+# replica_step kill mid-wave; every stream exact + exactly-once, dead
+# replica's flight-recorder bundle seals), drain-completes-running-
+# work, warm live join through the shared host tier (pytest.ini
+# `fleet` marker; docs/serving.md "Fleet serving & failover"). The
+# chaos-marked fleet scenario is then replayed across its own
+# DSTPU_FAULTS matrix: a transient route-site plan (placement degrades
+# to queue-depth-only) and a fatal replica_step plan (one of two
+# replicas dies mid-wave; failover must keep every stream exact).
+if [[ -z "$FILTER" || "fleet" == *"$FILTER"* || "serving" == *"$FILTER"* ]]; then
+  echo "=== fleet marker sweep (pytest -m fleet)"
+  if JAX_PLATFORMS=cpu python -m pytest tests/unit/test_fleet.py \
+       -m fleet -q --tb=short ${EXTRA_PYTEST_ARGS:-}; then
+    PASSED=$((PASSED + 1))
+  else
+    FAILED+=("pytest -m fleet")
+  fi
+  FLEET_CHAOS_MATRIX=(
+    "serving.fleet.route=fail:2:2"
+    "serving.fleet.replica_step=fatal:6:1"
+  )
+  for faults in "${FLEET_CHAOS_MATRIX[@]}"; do
+    echo "=== fleet-chaos sweep (DSTPU_FAULTS='${faults}')"
+    if DSTPU_FAULTS="$faults" JAX_PLATFORMS=cpu python -m pytest \
+         tests/unit/test_fleet.py -m chaos -q --tb=short \
+         ${EXTRA_PYTEST_ARGS:-}; then
+      PASSED=$((PASSED + 1))
+    else
+      FAILED+=("fleet-chaos [DSTPU_FAULTS=${faults}]")
+    fi
+  done
 fi
 
 # Multichip-serving sweep: the tensor-parallel suite runs the full
