@@ -1171,6 +1171,204 @@ def fleet_failover_bench(replicas: int = 2, rounds: int = 12,
         "decode_builds": killed["decode_builds"]}), flush=True)
 
 
+def disaggregated_fleet_bench(rounds: int = 18, new: int = 10,
+                              chips: int = 3, burst: int = 4,
+                              **model_kw):
+    """Price the disaggregated prefill/decode split (docs/serving.md
+    "Disaggregated fleet & autoscaling"): the same bursty two-tenant
+    trace runs twice on the SAME chip budget — once on a uniform
+    ``chips``-replica fleet, once on a 1-prefill + 1-decode split with
+    the SLO/queue-driven autoscaler allowed to grow the decode class up
+    to the budget.  An interactive tenant streams short prompts every
+    round while a batch tenant dumps long-prompt prefill bursts; in the
+    uniform fleet those prefill chunks ride the decode iterations and
+    inflate everyone's TTFT, in the split fleet they land on the
+    prefill worker and arrive at the decode class as claimable fabric
+    chains.  Reports per-tenant p99 TTFT and decode tokens/s for both
+    shapes — aggregate AND per decode-class chip (every uniform replica
+    is decode-class but spends iterations on prefill chunks; that
+    dilution is the interference disaggregation removes, so the
+    per-chip number is the one the split should win) — plus the
+    autoscaler's scale events against the wall time the uniform run's
+    running p99 first showed the breach (the scale-up should win that
+    race), and ``decode_builds`` per replica (must stay 1 — the handoff
+    rides the compiled mixed program, never a retrace).  Absolute
+    latencies are only meaningful on TPU."""
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.inference.serving import (FleetAutoscaler,
+                                                 FleetRouter)
+    from deepspeed_tpu.inference.serving.engine import ServingEngine
+    from deepspeed_tpu.inference.serving.fleet.replica import ReplicaHandle
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+    from deepspeed_tpu.observability.slo import KIND_TTFT, SloMonitor
+
+    cfg = gpt2_config("125m", dtype=jnp.float32, **model_kw)
+    tenants = ("interactive", "batch")
+    targets = {"interactive": 0.5, "batch": 1.5}
+
+    def build(replicas, prefill_replicas):
+        eng = ds.init_inference(TransformerLM(cfg), config={
+            "dtype": "float32", "max_out_tokens": 64,
+            "temperature": 0.0, "replace_with_kernel_inject": False,
+            "serving": {"enabled": True, "kv_block_size": 8,
+                        "num_kv_blocks": 64, "max_batch_slots": 4,
+                        "prefill_chunk_tokens": 8,
+                        "max_queue_depth": 32,
+                        "fleet": {"enabled": True, "replicas": replicas,
+                                  "prefill_replicas": prefill_replicas},
+                        "host_cache": {"enabled": True,
+                                       "dram_budget_bytes": 1 << 24,
+                                       "wire_bits": 0}}})
+        fleet = FleetRouter.from_engine(eng, rng=jax.random.PRNGKey(0))
+        return eng, fleet
+
+    def run(split: bool):
+        eng, fleet = build(chips if not split else 2,
+                           0 if not split else 1)
+        # warm every replica's compile before the clock; the 12-token
+        # prompt crosses a block boundary so the split fleet's warm-up
+        # also runs the publish→claim→promote handoff once
+        for _ in range(len(fleet.replicas)):
+            fleet.submit(list(range(1, 13)), max_new_tokens=4)
+        fleet.run()
+        auto = None
+        spawned = []
+        if split:
+            mon = SloMonitor(objective=0.9, fast_window_s=2.0,
+                             slow_window_s=8.0, burn_threshold=1.0,
+                             min_samples=3, time_fn=time.perf_counter)
+
+            def spawn(role):
+                srv = ServingEngine(
+                    eng, rng=jax.random.PRNGKey(2 + len(spawned)),
+                    shared_host_cache=fleet.shared_host_cache,
+                    role=role)
+                srv.publisher_id = f"as{len(spawned)}-{role}"
+                h = ReplicaHandle(f"as{len(spawned)}-{role}", srv,
+                                  role=role)
+                spawned.append(h)
+                return h
+
+            auto = FleetAutoscaler(
+                fleet, spawn, slo_monitor=mon, clock=time.perf_counter,
+                chip_budget=chips, scale_up_cooldown_s=0.5,
+                scale_down_cooldown_s=2.0, queue_high=3.0,
+                queue_low=1.0, quiet_s=1.0)
+        rs = np.random.RandomState(11)
+        ttft = {t: [] for t in tenants}
+        breach = {}
+
+        def hook(freq, tenant):
+            def _cb(ev):
+                if ev.token is None or ev.index != 0:
+                    return
+                lat = ev.time_s - freq.submit_time
+                ttft[tenant].append(lat)
+                if split:
+                    mon.observe(tenant, KIND_TTFT, lat, targets[tenant])
+                elif (tenant not in breach and len(ttft[tenant]) >= 3
+                      and float(np.percentile(ttft[tenant], 99))
+                      > targets[tenant]):
+                    # the uniform run's histogram view of the breach:
+                    # the "would-be" timestamp the split fleet's
+                    # scale-up must beat
+                    breach[tenant] = time.perf_counter()
+            return _cb
+
+        reqs = []
+        t0 = time.perf_counter()
+        for i in range(rounds):
+            p = rs.randint(0, cfg.vocab_size,
+                           (int(rs.randint(4, 9)),)).tolist()
+            freq = fleet.submit(p, max_new_tokens=new,
+                                tenant="interactive")
+            freq.on_token = hook(freq, "interactive")
+            reqs.append(freq)
+            if i in (2, rounds // 2):       # the prefill bursts
+                for _ in range(burst):
+                    p = rs.randint(0, cfg.vocab_size,
+                                   (int(rs.randint(36, 45)),)).tolist()
+                    freq = fleet.submit(p, max_new_tokens=6,
+                                        tenant="batch")
+                    freq.on_token = hook(freq, "batch")
+                    reqs.append(freq)
+            fleet.pump()
+            if auto is not None:
+                auto.tick()
+        fleet.run()
+        dt = time.perf_counter() - t0
+        assert all(r.status is not None and r.status.value == "ok"
+                   for r in reqs), "a request did not survive the trace"
+        builds = [r.srv.decode_builds for r in fleet.replicas
+                  if r.srv.decode_builds]
+        assert all(b == 1 for b in builds), \
+            "the disaggregated handoff retraced a replica"
+        decode_chips = max(
+            1, sum(r.role != "prefill" for r in fleet.replicas))
+        tok_s = sum(len(r.output) for r in reqs) / dt
+        out = {
+            "replicas": [(r.replica_id, r.role) for r in fleet.replicas],
+            "decode_tokens_per_sec": round(tok_s, 1),
+            "decode_tokens_per_sec_per_decode_chip": round(
+                tok_s / decode_chips, 1),
+            "ttft_p99_ms": {
+                t: round(float(np.percentile(ttft[t], 99)) * 1e3, 2)
+                for t in tenants if ttft[t]},
+            "decode_builds": builds}
+        if split:
+            out["handoffs"] = fleet.fleet_counts["handoffs"]
+            out["fabric"] = {
+                "published": fleet.shared_host_cache.published_total,
+                "claim_hits": sum(
+                    fleet.shared_host_cache.hits_total.values())}
+            out["scale_events"] = [
+                {"at_s": round(e["t"] - t0, 3), "action": e["action"],
+                 "role": e["role"], "reason": e["reason"]}
+                for e in (auto.events if auto else [])]
+            # close the loop: quiet tail scale-down + orphan hygiene
+            deadline = time.perf_counter() + 3.0
+            while (auto and auto.counts["scale_ups"]
+                   and not auto.counts["scale_downs"]
+                   and time.perf_counter() < deadline):
+                time.sleep(0.2)
+                fleet.pump()
+                auto.tick()
+            fleet.reap_orphans()
+            assert fleet.shared_host_cache.published_entries() == 0, \
+                "orphaned fabric entries survived the drain"
+            out["scale_downs"] = auto.counts["scale_downs"] if auto else 0
+        else:
+            out["p99_breach_at_s"] = {
+                t: round(breach[t] - t0, 3) for t in breach}
+        return out
+
+    uniform = run(split=False)
+    disagg = run(split=True)
+    ups = [e for e in disagg["scale_events"] if e["action"] == "up"]
+    first_up_s = ups[0]["at_s"] if ups else None
+    breach_s = min(uniform["p99_breach_at_s"].values(), default=None) \
+        if uniform["p99_breach_at_s"] else None
+    print(json.dumps({
+        "metric": "disaggregated_fleet",
+        "value": disagg["ttft_p99_ms"].get("interactive"),
+        "unit": "ms", "chips": chips, "rounds": rounds,
+        "uniform": uniform, "disagg": disagg,
+        "scale_up_before_breach": (
+            first_up_s is not None
+            and (breach_s is None or first_up_s <= breach_s)),
+        "first_scale_up_s": first_up_s,
+        "uniform_breach_s": breach_s,
+        "disagg_wins_ttft": (
+            uniform["ttft_p99_ms"].get("interactive", 0)
+            > disagg["ttft_p99_ms"].get("interactive", float("inf"))),
+        "disagg_wins_decode_throughput": (
+            disagg["decode_tokens_per_sec_per_decode_chip"]
+            > uniform["decode_tokens_per_sec_per_decode_chip"])}),
+        flush=True)
+
+
 def main():
     import jax
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -1184,6 +1382,7 @@ def main():
         serving_decode_bench()
         multi_tenant_replay_bench(spec_k=3)
         fleet_failover_bench()
+        disaggregated_fleet_bench()
         prefix_cache_bench()
         tiered_prefix_cache_bench()
         paged_decode_attention_bench()
@@ -1206,6 +1405,12 @@ def main():
         # numbers rank the path's overheads, not TPU latency
         fleet_failover_bench(num_layers=2, d_model=64, num_heads=4,
                              vocab_size=256, max_seq_len=128)
+        # uniform-vs-disaggregated on the same chip budget: CPU smoke
+        # checks the scale-up-beats-breach race and handoff hygiene,
+        # not absolute latency
+        disaggregated_fleet_bench(rounds=10, new=8,
+                                  num_layers=2, d_model=64, num_heads=4,
+                                  vocab_size=256, max_seq_len=128)
         # tiny-model tier sweep: exercises spill -> host -> promote on
         # the interpret-mode kernels; ratios are indicative only on CPU
         import jax.numpy as jnp

@@ -115,6 +115,20 @@ class FleetConfig(ConfigModel):
     max_failovers: int = C.SERVING_FLEET_MAX_FAILOVERS_DEFAULT
     retry_base_delay_s: float = C.SERVING_FLEET_RETRY_BASE_DELAY_S_DEFAULT
     retry_max_delay_s: float = C.SERVING_FLEET_RETRY_MAX_DELAY_S_DEFAULT
+    #: disaggregated fleet: first K replicas prefill-only publishers,
+    #: rest decode (0 = uniform); requires the host-tier KV fabric
+    prefill_replicas: int = C.SERVING_FLEET_PREFILL_REPLICAS_DEFAULT
+    #: affinity credit for fabric-resident vs device-resident prefix
+    promote_discount: float = C.SERVING_FLEET_PROMOTE_DISCOUNT_DEFAULT
+    # autoscaler policy knobs (fleet/autoscaler.py)
+    chip_budget: int = C.SERVING_FLEET_CHIP_BUDGET_DEFAULT
+    scale_up_cooldown_s: float = \
+        C.SERVING_FLEET_SCALE_UP_COOLDOWN_S_DEFAULT
+    scale_down_cooldown_s: float = \
+        C.SERVING_FLEET_SCALE_DOWN_COOLDOWN_S_DEFAULT
+    queue_high: float = C.SERVING_FLEET_QUEUE_HIGH_DEFAULT
+    queue_low: float = C.SERVING_FLEET_QUEUE_LOW_DEFAULT
+    quiet_s: float = C.SERVING_FLEET_QUIET_S_DEFAULT
 
     @model_validator(mode="after")
     def _validate(self):
@@ -147,6 +161,32 @@ class FleetConfig(ConfigModel):
             raise ValueError(
                 "serving.fleet retry delays must satisfy "
                 "0 < retry_base_delay_s <= retry_max_delay_s")
+        if not 0 <= self.prefill_replicas < self.replicas:
+            # a disaggregated split must leave >= 1 decode replica —
+            # a fleet of pure publishers can never stream a token
+            raise ValueError(
+                f"serving.fleet.prefill_replicas must be in "
+                f"[0, replicas), got {self.prefill_replicas} of "
+                f"{self.replicas}")
+        if not 0.0 <= self.promote_discount <= 1.0:
+            raise ValueError(
+                f"serving.fleet.promote_discount must be in [0, 1], "
+                f"got {self.promote_discount}")
+        if self.chip_budget < 1:
+            raise ValueError(
+                f"serving.fleet.chip_budget must be >= 1, got "
+                f"{self.chip_budget}")
+        if self.scale_up_cooldown_s <= 0 or self.scale_down_cooldown_s <= 0:
+            raise ValueError(
+                "serving.fleet scale cooldowns must be > 0 — a zero "
+                "cooldown lets an alert storm scale at tick rate")
+        if self.queue_low > self.queue_high:
+            raise ValueError(
+                f"serving.fleet.queue_low ({self.queue_low}) must be <= "
+                f"queue_high ({self.queue_high})")
+        if self.quiet_s < 0:
+            raise ValueError(
+                f"serving.fleet.quiet_s must be >= 0, got {self.quiet_s}")
         return self
 
 

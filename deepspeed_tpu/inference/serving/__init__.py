@@ -25,8 +25,9 @@ from .block_allocator import (BlockPoolError, NULL_BLOCK,  # noqa: F401
                               PagedBlockAllocator, blocks_for_budget,
                               kv_block_bytes)
 from .engine import ServingEngine  # noqa: F401
-from .fleet import (FleetRequest, FleetRouter,  # noqa: F401
-                    ReplicaHandle, ReplicaState, placement_score)
+from .fleet import (FleetAutoscaler, FleetRequest,  # noqa: F401
+                    FleetRouter, ReplicaHandle, ReplicaState,
+                    placement_score)
 from .frontend import (ServingFrontend, StreamCollector,  # noqa: F401
                        StreamDeduper, TokenEvent, TenantRegistry,
                        TenantSpec)
@@ -37,7 +38,8 @@ from .scheduler import (ContinuousBatchingScheduler, Request,  # noqa: F401
 
 __all__ = ["BlockCodec", "BlockPoolError", "NULL_BLOCK",
            "PagedBlockAllocator",
-           "ContinuousBatchingScheduler", "FleetRequest", "FleetRouter",
+           "ContinuousBatchingScheduler", "FleetAutoscaler",
+           "FleetRequest", "FleetRouter",
            "HostTierCache", "ReplicaHandle", "ReplicaState", "Request",
            "RequestState", "RequestStatus", "ServingEngine",
            "ServingError", "ServingFrontend", "SloAlert", "SloMonitor",

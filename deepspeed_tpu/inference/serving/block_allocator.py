@@ -178,6 +178,10 @@ class PagedBlockAllocator:
         # blocks claimed by a host hit whose payload has not landed yet
         self._host = None
         self._spill_fn = None
+        # prefill-class engines publish chains to the fabric but never
+        # claim from it (claiming would steal entries the decode class
+        # is about to promote); the engine flips this per role
+        self.allow_claims = True
         self._pending_blocks: Dict[int, bytes] = {}
         self._promote_jobs: "OrderedDict[bytes, PromoteJob]" = OrderedDict()
         # cumulative stats the serving engine polls into the metrics
@@ -234,6 +238,8 @@ class PagedBlockAllocator:
         queue the promotion.  Returns the (pending) block id, or None
         on a genuine miss / no pool capacity (the entry then stays
         host-resident and warm — a miss, never an error)."""
+        if not self.allow_claims:
+            return None
         if self._host is None or not self._host.contains(h):
             return None
         if not (self._free or self._cached_lru):
@@ -248,6 +254,21 @@ class PagedBlockAllocator:
         self._pending_blocks[b] = h
         self._promote_jobs[h] = PromoteJob(h, b, payload)
         return b
+
+    def _drop_host_duplicate(self, h: bytes) -> None:
+        """A device hit on a digest the host tier also holds: the host
+        copy is redundant (a prefill publisher may have republished
+        content this replica never evicted) — drop it eagerly so the
+        cross-tier disjointness self-heals instead of waiting for an
+        orphan sweep."""
+        if self._host is not None:
+            self._host.discard(h)
+
+    def seq_chain(self, seq_id: str) -> List[bytes]:
+        """The chained content digests of ``seq_id``'s committed full
+        blocks, in block order — the transport keys a prefill worker
+        publishes to the KV fabric (digest i keys ``table[i]``)."""
+        return list(self._chain.get(seq_id, ()))
 
     def pending_jobs(self) -> List[PromoteJob]:
         """Queued promotions, oldest first (the engine drains up to
@@ -417,8 +438,10 @@ class PagedBlockAllocator:
                     host_tokens += bs
                 elif self._ref[b] == 0:
                     self._claim_cached(b)
+                    self._drop_host_duplicate(h)
                 else:
                     self._ref[b] += 1
+                    self._drop_host_duplicate(h)
                 blocks.append(b)
                 chain.append(h)
                 cached_tokens += bs
@@ -457,7 +480,8 @@ class PagedBlockAllocator:
                 live_hits += 1
         return need - live_hits
 
-    def probe_prefix_coverage(self, token_ids: Sequence[int]) -> int:
+    def probe_prefix_coverage(self, token_ids: Sequence[int],
+                              split: bool = False):
         """READ-ONLY affinity probe for the fleet router: how many
         leading tokens of ``token_ids`` this pool (device radix index
         OR attached host tier) already covers, walking the same chained
@@ -465,20 +489,32 @@ class PagedBlockAllocator:
         the first miss.  Mutates nothing — no claims, no LRU touches,
         no promotions — so the router may probe every replica per
         placement decision (docs/serving.md "Fleet serving &
-        failover")."""
+        failover").
+
+        With ``split=True`` returns ``(device_tokens, host_tokens)``
+        instead of their sum, so the router can discount host-resident
+        coverage by the promote cost: a block in the host tier saves
+        the recompute but still pays a claim + host->device landing.
+        Host residency only counts when this allocator may actually
+        claim it (``allow_claims``)."""
         if not self.enable_prefix_cache or not token_ids:
-            return 0
+            return (0, 0) if split else 0
         bs = self.block_size
         max_hit_blocks = max(0, (len(token_ids) - 1) // bs)
-        h, covered = ROOT_HASH, 0
+        h = ROOT_HASH
+        dev_blocks = host_blocks = 0
         for i in range(max_hit_blocks):
             h = _chain_hash(h, tuple(token_ids[i * bs:(i + 1) * bs]))
-            if h in self._hash_to_block or (
-                    self._host is not None and self._host.contains(h)):
-                covered += 1
+            if h in self._hash_to_block:
+                dev_blocks += 1
+            elif (self.allow_claims and self._host is not None
+                    and self._host.contains(h)):
+                host_blocks += 1
             else:
                 break
-        return covered * bs
+        if split:
+            return dev_blocks * bs, host_blocks * bs
+        return (dev_blocks + host_blocks) * bs
 
     def append_block(self, seq_id: str) -> int:
         """Grow a sequence by one block (decode crossed a block

@@ -123,9 +123,30 @@ class ServingEngine:
 
     def __init__(self, engine, rng: Optional[jax.Array] = None,
                  draft_model=None, draft_params=None,
-                 shared_host_cache: Optional[HostTierCache] = None):
+                 shared_host_cache: Optional[HostTierCache] = None,
+                 role: str = "mixed"):
         cfg = engine.config.serving
         model = engine.module
+        # disaggregated fleet replica class (docs/serving.md
+        # "Disaggregated fleet & autoscaling"): a "prefill" engine runs
+        # chunked prefill only and publishes finished chains to the KV
+        # fabric; "decode"/"mixed" engines serve full requests ("decode"
+        # is a routing preference, not an engine-side restriction, so a
+        # degraded fleet can still fall back to any replica)
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"serving role must be 'mixed', 'prefill' or 'decode', "
+                f"got {role!r}")
+        if role == "prefill" and not cfg.host_cache.enabled:
+            raise ValueError(
+                "role='prefill' requires serving.host_cache.enabled — "
+                "the host tier IS the KV fabric prefill workers publish "
+                "finished chains into")
+        self.role = role
+        #: fabric identity for published entries (orphan reaping is
+        #: publisher-scoped); the fleet router overwrites this with the
+        #: replica id at construction
+        self.publisher_id = f"engine-{id(self):x}"
         reason = model._paged_supported()
         if reason is not None:
             raise NotImplementedError(
@@ -141,6 +162,10 @@ class ServingEngine:
         self.allocator = PagedBlockAllocator(
             cfg.num_kv_blocks, self.block_size,
             enable_prefix_cache=cfg.prefix_cache)
+        # a prefill worker publishes to the fabric but never claims from
+        # it: claiming would steal the very entries the decode class is
+        # about to promote
+        self.allocator.allow_claims = role != "prefill"
         self.scheduler = ContinuousBatchingScheduler(
             self.num_slots, self.allocator, self.max_pages,
             max_queue_depth=cfg.max_queue_depth,
@@ -220,6 +245,12 @@ class ServingEngine:
         #: plain-int mirrors for bench_all / callers without the registry
         self.host_counts = {"promoted_blocks": 0, "promote_failures": 0,
                             "spill_failures": 0}
+        #: KV-fabric mirrors (disaggregated fleet): chain blocks this
+        #: engine published, publishes degraded to decode-side
+        #: recompute, and prefill-only requests completed
+        self.fabric_counts = {"published_blocks": 0,
+                              "publish_failures": 0,
+                              "prefill_only_completed": 0}
         #: wall seconds inside _service_promotions — with
         #: ``promoted_blocks * codec.nbytes`` this is the promote
         #: bandwidth the tiered-cache bench reports
@@ -418,6 +449,14 @@ class ServingEngine:
         self._m_spill_failures = reg.counter(
             "dstpu_serving_spill_failures_total",
             "spills degraded to plain eviction (host store fault)")
+        # KV-fabric metrics (docs/serving.md "Disaggregated fleet &
+        # autoscaling"): prefill-side publishes and their degradations
+        self._m_fabric_published = reg.counter(
+            "dstpu_serving_fabric_published_total",
+            "finished-chain blocks published into the KV fabric")
+        self._m_fabric_publish_failures = reg.counter(
+            "dstpu_serving_fabric_publish_failures_total",
+            "fabric publishes degraded to decode-side recompute")
         self._m_host_dram_bytes = reg.gauge(
             "dstpu_serving_host_dram_bytes",
             "encoded KV bytes resident in the host DRAM tier")
@@ -685,6 +724,81 @@ class ServingEngine:
                 f"serving: spill of block {block} failed ({e!r}) — "
                 f"degraded to plain eviction")
 
+    def _publish_block(self, block: int, digest: bytes) -> bool:
+        """Push one finished-chain block into the KV fabric (same
+        gather + wire-codec path as :meth:`_spill_block`, but through
+        :meth:`HostTierCache.publish` so the entry carries a crc32 and
+        this engine's publisher id).  NEVER raises: the
+        ``serving.fabric.publish`` site fires inside ``publish`` before
+        any fabric mutation, transient faults retry under the
+        resilience backoff, and any terminal failure degrades to
+        decode-side recompute — a handoff miss, never a wrong token."""
+        try:
+            with trace_span("serving/fabric_publish", block=block):
+                bi = jnp.asarray(block, jnp.int32)
+                if self.kv_bits:
+                    k, v, ks, vs = self._gather_block(
+                        self._pool_k, self._pool_v, self._pool_ks,
+                        self._pool_vs, bi)
+                    payload = self._hc_codec.encode(
+                        np.asarray(k), np.asarray(v),
+                        np.asarray(ks), np.asarray(vs))
+                else:
+                    k, v = self._gather_block(self._pool_k,
+                                              self._pool_v, bi)
+                    payload = self._hc_codec.encode(np.asarray(k),
+                                                    np.asarray(v))
+
+                def _pub():
+                    self.host_cache.publish(digest, payload,
+                                            publisher=self.publisher_id)
+                retry_call(_pub,
+                           what=f"fabric publish of block {block}")
+            self.fabric_counts["published_blocks"] += 1
+            self._m_fabric_published.inc()
+            return True
+        except Exception as e:   # noqa: BLE001 — degrade, never raise
+            self.fabric_counts["publish_failures"] += 1
+            self._m_fabric_publish_failures.inc()
+            logger.warning(
+                f"serving: fabric publish of block {block} failed "
+                f"({e!r}) — decode leg will recompute")
+            return False
+
+    def _publish_chain(self, req) -> int:
+        """Publish every committed full block of ``req``'s chain, in
+        block order, stopping at the first failure so published chains
+        stay prefix-contiguous (the decode-side hit walk stops at its
+        first miss — a gap would strand the tail as unclaimable
+        orphans).  Returns blocks published."""
+        if self.host_cache is None:
+            return 0
+        alloc = self.allocator
+        table = alloc.block_table(req.req_id)
+        published = 0
+        for digest, block in zip(alloc.seq_chain(req.req_id), table):
+            if not self._publish_block(block, digest):
+                break
+            published += 1
+        return published
+
+    def _finish_prefill_only(self, slot: int, req) -> None:
+        """A ``prefill_only`` request's target landed: publish the
+        finished chain to the fabric, OK-finish the slot with its
+        blocks unregistered (the digests now live fabric-side only),
+        and close the stream with a tokenless OK terminal event — the
+        router's handoff trigger.  No token is ever sampled or emitted
+        on the prefill leg; the decode leg starts its stream at output
+        index 0 with the pinned key."""
+        self._publish_chain(req)
+        self.fabric_counts["prefill_only_completed"] += 1
+        self.scheduler.finish_prefill(slot)
+        now = time.perf_counter()
+        self._event_buf.append(TokenEvent(
+            request=req, token=None, index=0, status=req.status,
+            final=True, tenant=req.tenant, time_s=now,
+            prev_time_s=None))
+
     def _service_promotions(self) -> int:
         """Land up to ``promote_parallelism`` queued host->pool block
         promotions (admission-window work: the scheduler holds the
@@ -882,7 +996,8 @@ class ServingEngine:
                top_p: Optional[float] = None,
                seed: Optional[int] = None,
                on_token: Optional[Callable] = None,
-               tenant: str = "default") -> Request:
+               tenant: str = "default",
+               prefill_only: bool = False) -> Request:
         """Queue a request.  ``deadline_s`` is a TTL from submit, swept
         every ``step()`` whether the request is still WAITING or already
         RUNNING (defaults to ``serving.default_deadline_s``; 0 = none).
@@ -898,7 +1013,18 @@ class ServingEngine:
         the stream is reproducible regardless of batching.
         ``on_token`` receives a :class:`TokenEvent` per emitted token
         at iteration boundaries.  ``tenant`` tags the request for the
-        multi-tenant frontend's fairness accounting."""
+        multi-tenant frontend's fairness accounting.
+
+        ``prefill_only`` runs the prefill leg of a disaggregated
+        handoff: the prompt's KV is computed (and published to the KV
+        fabric when the host tier is attached), NO token is emitted,
+        and the stream closes with a tokenless OK terminal event the
+        moment the prefill target lands."""
+        if prefill_only and self.host_cache is None:
+            raise ValueError(
+                "prefill_only requires the host-tier KV fabric "
+                "(serving.host_cache.enabled) — there is nowhere to "
+                "publish the finished chain")
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         total = len(prompt) + max_new_tokens
         if total > self.engine.config.max_out_tokens:
@@ -929,7 +1055,8 @@ class ServingEngine:
                       eos_token_id=eos_token_id,
                       deadline_s=deadline_s if deadline_s else None,
                       temperature=temperature, top_k=top_k, top_p=top_p,
-                      prng_key=key, on_token=on_token, tenant=tenant)
+                      prng_key=key, on_token=on_token, tenant=tenant,
+                      prefill_only=prefill_only)
         self.scheduler.submit(req)
         self._drain_terminal_events()
         self._m_queue.set(self.scheduler.queue_depth)
@@ -1402,7 +1529,15 @@ class ServingEngine:
                     self._rt.on_prefill_chunk(
                         req, t0, dispatch_dt, c_start, c_len,
                         done=req.cached_tokens >= req.prefill_target)
-                if req.cached_tokens >= req.prefill_target:
+                if (req.cached_tokens >= req.prefill_target
+                        and req.prefill_only):
+                    # prefill leg of a disaggregated handoff: publish
+                    # the chain, finish OK, emit no token — the decode
+                    # leg samples output index 0 with the same pinned
+                    # key, so the stream is identical to a one-replica
+                    # run
+                    self._finish_prefill_only(chunk[0], req)
+                elif req.cached_tokens >= req.prefill_target:
                     # the chunk that completed the prefix carries the
                     # first token (sampled from its last valid position
                     # with the request's own key at output index 0 —

@@ -11,8 +11,9 @@ and a per-request :class:`~..frontend.streaming.StreamDeduper` forwards
 only tokens past the delivered high-water mark, so clients observe
 exactly-once token delivery with no visible restart.
 """
+from .autoscaler import FleetAutoscaler
 from .replica import ReplicaHandle, ReplicaState
 from .router import FleetRequest, FleetRouter, placement_score
 
-__all__ = ["ReplicaHandle", "ReplicaState", "FleetRequest",
-           "FleetRouter", "placement_score"]
+__all__ = ["FleetAutoscaler", "ReplicaHandle", "ReplicaState",
+           "FleetRequest", "FleetRouter", "placement_score"]

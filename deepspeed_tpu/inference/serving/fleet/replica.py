@@ -68,6 +68,9 @@ class SubmitSpec:
     #: after the engine accepts (NOT called for a submit-time shed:
     #: the shed's tokenless terminal event already reached on_token)
     on_submitted: Optional[Callable] = None
+    #: disaggregated prefill leg: compute + publish the prompt's KV,
+    #: emit no tokens, finish OK at prefill completion
+    prefill_only: bool = False
 
 
 class ReplicaHandle:
@@ -76,9 +79,14 @@ class ReplicaHandle:
     def __init__(self, replica_id: str, serving_engine,
                  heartbeat_path: Optional[str] = None,
                  heartbeat_interval_s: float = 1.0,
-                 heartbeat_timeout_s: float = 0.0):
+                 heartbeat_timeout_s: float = 0.0,
+                 role: str = "mixed"):
         self.replica_id = replica_id
         self.srv = serving_engine
+        #: replica class for disaggregated placement: "prefill" runs
+        #: handoff prefill legs only; "decode"/"mixed" serve streams
+        #: (docs/serving.md "Disaggregated fleet & autoscaling")
+        self.role = role
         self.state = ReplicaState.STARTING
         self.death_reason: Optional[str] = None
         self.heartbeat_path = heartbeat_path
@@ -127,11 +135,15 @@ class ReplicaHandle:
             inbox = len(self._inbox)
         return self.srv.scheduler.queue_depth + inbox
 
-    def prefix_coverage(self, token_ids: Sequence[int]) -> int:
+    def prefix_coverage(self, token_ids: Sequence[int],
+                        split: bool = False):
         """Leading prompt tokens this replica's pool (device radix index
         or shared host tier) already covers — the affinity key.  Pure
-        read, never mutates allocator state."""
-        return self.srv.allocator.probe_prefix_coverage(token_ids)
+        read, never mutates allocator state.  ``split=True`` returns
+        ``(device_tokens, host_tokens)`` so the router can discount
+        host-resident coverage by the promote cost."""
+        return self.srv.allocator.probe_prefix_coverage(token_ids,
+                                                        split=split)
 
     def has_work(self) -> bool:
         with self._lock:
@@ -219,7 +231,7 @@ class ReplicaHandle:
             eos_token_id=spec.eos_token_id, deadline_s=spec.deadline_s,
             temperature=spec.temperature, top_k=spec.top_k,
             top_p=spec.top_p, seed=spec.seed, on_token=spec.on_token,
-            tenant=spec.tenant)
+            tenant=spec.tenant, prefill_only=spec.prefill_only)
         if req.status is not None:
             # shed at submit: the tokenless terminal event already
             # reached on_token inside submit() — nothing to record

@@ -25,6 +25,16 @@ fatal → the one request FAILs, the router's 500);
 ``serving.fleet.replica_step`` fires in :meth:`ReplicaHandle.step`
 (transient → skip the iteration; fatal → the replica is DEAD and the
 failover path runs).
+
+Disaggregated serving (docs/serving.md "Disaggregated fleet &
+autoscaling"): with prefill-class replicas present, each request runs a
+two-leg plan — a ``prefill_only`` leg on the prefill class computes the
+prompt's KV and publishes the chain into the shared host tier (the KV
+fabric), then a decode leg claims-and-promotes it on the decode class
+and streams tokens with the SAME pinned fold-in key, so the handoff is
+token-exact by construction.  Every fabric failure (publish fault,
+corrupt/evicted entry, prefill replica death) degrades the decode leg
+to an ordinary cold prefill: never a wrong token, never a stall.
 """
 from __future__ import annotations
 
@@ -46,7 +56,9 @@ from .replica import ReplicaHandle, ReplicaState, SubmitSpec
 
 def placement_score(covered_tokens: int, queue_depth: int,
                     affinity_weight: float = 1.0,
-                    queue_cost_tokens: float = 32.0) -> float:
+                    queue_cost_tokens: float = 32.0,
+                    host_covered_tokens: int = 0,
+                    promote_discount: float = 0.5) -> float:
     """Pure placement score: warm prefix tokens minus queueing cost.
 
     A replica whose caches already cover ``covered_tokens`` of the
@@ -54,8 +66,16 @@ def placement_score(covered_tokens: int, queue_depth: int,
     waiting costs roughly ``queue_cost_tokens`` of extra latency-
     equivalent work.  The router places on the argmax, so affinity wins
     only when the warm prefix outweighs the queue imbalance it would
-    create."""
-    return (affinity_weight * covered_tokens
+    create.
+
+    ``host_covered_tokens`` are prefix tokens resident in the host
+    tier / KV fabric rather than the device radix index: they still
+    save the recompute but pay a claim + promote landing, so they are
+    credited at ``promote_discount`` of a device-resident token —
+    placement prefers a replica that can promote over one that must
+    recompute, and a replica with the KV already on-device over both."""
+    return (affinity_weight
+            * (covered_tokens + promote_discount * host_covered_tokens)
             - queue_cost_tokens * queue_depth)
 
 
@@ -98,6 +118,17 @@ class FleetRequest:
     #: NOT re-placed (the honored retry_after_s backoff)
     retry_at: float = 0.0
     _closed: bool = False
+    #: disaggregated two-leg plan state: "auto" (plan at placement),
+    #: "prefill" (leg 1 in flight on the prefill class), "decode"
+    #: (handoff done, stream on the decode class), "direct" (single-leg
+    #: cold path — no prefill class, short prompt, warm decode replica,
+    #: or a degraded handoff)
+    leg: str = "auto"
+    #: leg 1 completed and its chain is published — sticky: a decode-leg
+    #: failover must not re-run prefill
+    prefill_done: bool = False
+    #: replica id that ran the prefill leg (flight-recorder context)
+    prefill_replica_id: Optional[str] = None
 
     @property
     def output(self) -> List[int]:
@@ -117,10 +148,12 @@ class FleetRouter:
                  queue_cost_tokens: float = 32.0,
                  max_failovers: int = 3,
                  retry_policy: Optional[RetryPolicy] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 promote_discount: float = 0.5):
         self.replicas: List[ReplicaHandle] = []
         self.affinity_weight = affinity_weight
         self.queue_cost_tokens = queue_cost_tokens
+        self.promote_discount = promote_discount
         self.max_failovers = max_failovers
         self.retry_policy = retry_policy or RetryPolicy()
         self.clock = clock
@@ -155,10 +188,21 @@ class FleetRouter:
         self._m_routable = reg.gauge(
             "dstpu_fleet_routable_replicas",
             "replicas currently accepting new routes")
+        self._m_handoffs = reg.counter(
+            "dstpu_fleet_handoffs_total",
+            "prefill->decode handoffs completed through the KV fabric")
+        self._m_prefill_degraded = reg.counter(
+            "dstpu_fleet_prefill_degraded_total",
+            "prefill legs degraded to decode-side cold recompute")
+        self._m_orphans_reaped = reg.counter(
+            "dstpu_fleet_fabric_orphans_reaped_total",
+            "published-never-claimed fabric entries swept after a "
+            "publisher died or drained")
         #: plain-int mirrors for the bench / callers without the registry
         self.fleet_counts = {"failovers": 0, "replayed_tokens": 0,
                              "dead_replicas": 0, "shed_retries": 0,
-                             "drains": 0, "joins": 0}
+                             "drains": 0, "joins": 0, "handoffs": 0,
+                             "prefill_degraded": 0, "orphans_reaped": 0}
         for r in replicas:
             if r.state is ReplicaState.STARTING:
                 r.join()
@@ -171,7 +215,8 @@ class FleetRouter:
     @classmethod
     def from_engine(cls, engine, rng=None, draft_model=None,
                     draft_params=None, replicas: Optional[int] = None,
-                    heartbeat_dir: Optional[str] = None
+                    heartbeat_dir: Optional[str] = None,
+                    prefill_replicas: Optional[int] = None
                     ) -> "FleetRouter":
         """Build ``serving.fleet.replicas`` independent ``ServingEngine``
         replicas over one inference engine (shared weights, per-replica
@@ -181,37 +226,63 @@ class FleetRouter:
         seedless submit replays exactly wherever it lands.  With
         ``heartbeat_dir`` and ``serving.fleet.heartbeat_timeout_s`` set,
         threaded replicas also get the ``ReplicaLivenessMonitor``
-        staleness check (elasticity/serving_fleet.py)."""
+        staleness check (elasticity/serving_fleet.py).
+
+        ``prefill_replicas`` (default ``serving.fleet.prefill_replicas``,
+        0 = uniform fleet) splits the fleet into classes: the first K
+        replicas become prefill workers (``p0..``, publish-only against
+        the shared host tier, which the split REQUIRES) and the rest
+        decode replicas (``d0..``); requests then run the two-leg
+        handoff plan."""
         from ....elasticity import ReplicaLivenessMonitor
         from ..engine import ServingEngine
         cfg = engine.config.serving.fleet
         n = replicas if replicas is not None else cfg.replicas
+        k = (prefill_replicas if prefill_replicas is not None
+             else cfg.prefill_replicas)
+        if k < 0 or (k and k >= n):
+            raise ValueError(
+                f"prefill_replicas must be 0 (uniform) or leave at "
+                f"least one decode replica: got {k} of {n}")
+        if k and not engine.config.serving.host_cache.enabled:
+            raise ValueError(
+                "a disaggregated fleet (prefill_replicas > 0) requires "
+                "serving.host_cache.enabled — the shared host tier IS "
+                "the KV fabric between the classes")
         monitor = None
         if heartbeat_dir is not None and cfg.heartbeat_timeout_s:
             monitor = ReplicaLivenessMonitor(
                 heartbeat_dir, cfg.heartbeat_timeout_s)
         handles, shared = [], None
         for i in range(n):
+            if k:
+                role = "prefill" if i < k else "decode"
+                rid = f"p{i}" if i < k else f"d{i - k}"
+            else:
+                role, rid = "mixed", f"r{i}"
             srv = ServingEngine(engine, rng=rng,
                                 draft_model=draft_model,
                                 draft_params=draft_params,
-                                shared_host_cache=shared)
+                                shared_host_cache=shared,
+                                role=role)
+            srv.publisher_id = rid
             if shared is None:
                 shared = srv.host_cache
-            rid = f"r{i}"
             handles.append(ReplicaHandle(
                 rid, srv,
                 heartbeat_path=(monitor.path_for(rid)
                                 if monitor else None),
                 heartbeat_interval_s=cfg.heartbeat_interval_s,
                 heartbeat_timeout_s=(cfg.heartbeat_timeout_s
-                                     if monitor else 0.0)))
+                                     if monitor else 0.0),
+                role=role))
         return cls(handles,
                    affinity_weight=cfg.affinity_weight,
                    max_failovers=cfg.max_failovers,
                    retry_policy=RetryPolicy(
                        base_delay_s=cfg.retry_base_delay_s,
-                       max_delay_s=cfg.retry_max_delay_s))
+                       max_delay_s=cfg.retry_max_delay_s),
+                   promote_discount=cfg.promote_discount)
 
     # -- introspection -----------------------------------------------------
     @property
@@ -259,6 +330,7 @@ class FleetRouter:
     def _try_place(self, freq: FleetRequest) -> None:
         """Pick a replica and hand the request over; an unplaceable or
         shed request lands in the pending queue with its backoff."""
+        freq.leg = self._plan_leg(freq)
         target = self._pick(freq)
         if freq.status is not None:
             return                       # fatal route fault terminal
@@ -273,12 +345,60 @@ class FleetRouter:
             return
         self._submit_to(target, freq)
 
+    @staticmethod
+    def _role(r: ReplicaHandle) -> str:
+        return getattr(r, "role", "mixed")
+
+    def _coverage(self, r: ReplicaHandle,
+                  prompt: List[int]) -> Tuple[int, int]:
+        """(device, host) coverage; older handles without split support
+        report everything as device-resident."""
+        try:
+            return r.prefix_coverage(prompt, split=True)
+        except TypeError:
+            return r.prefix_coverage(prompt), 0
+
+    def _plan_leg(self, freq: FleetRequest) -> str:
+        """Decide which leg places next.  "decode" and "direct" are
+        sticky (the handoff happened / was degraded); otherwise a
+        prefill leg runs only when a prefill-class replica is routable,
+        the prompt has publishable full blocks, and no decode-side
+        replica already covers all of them (a covered prompt promotes
+        or hits — re-prefilling it would just republish what the fabric
+        already holds)."""
+        if freq.prefill_done or freq.leg == "decode":
+            return "decode"
+        if freq.leg == "direct":
+            return "direct"
+        pre = [r for r in self.routable_replicas
+               if self._role(r) == "prefill"]
+        if not pre:
+            return "direct"
+        try:
+            bs = pre[0].srv.block_size
+        except AttributeError:
+            return "direct"
+        full_tokens = max(0, (len(freq.prompt) - 1) // bs) * bs
+        if full_tokens <= 0:
+            return "direct"              # nothing publishable
+        for r in self.routable_replicas:
+            if self._role(r) == "prefill":
+                continue
+            dev, host = self._coverage(r, freq.prompt)
+            if dev + host >= full_tokens:
+                return "direct"          # warm decode path
+        return "prefill"
+
     def _pick(self, freq: FleetRequest) -> Optional[ReplicaHandle]:
         """Score routable replicas: prefix affinity (chain-digest
-        coverage, read-only probe) traded against queue depth.  The
-        ``serving.fleet.route`` site fires per placement decision —
-        transient degrades THIS decision to queue-depth-only, fatal
-        FAILs the request."""
+        coverage, read-only probe; host/fabric residency discounted by
+        the promote cost) traded against queue depth.  The candidate
+        set is class-aware: a prefill leg only lands on the prefill
+        class; a decode/direct leg prefers the decode class but may
+        fall back to ANY routable replica when the class is empty — a
+        degraded fleet keeps serving.  The ``serving.fleet.route`` site
+        fires per placement decision — transient degrades THIS decision
+        to queue-depth-only, fatal FAILs the request."""
         try:
             get_fault_injector().check("serving.fleet.route")
             use_affinity = True
@@ -289,19 +409,33 @@ class FleetRouter:
                               f"fatal fault at serving.fleet.route: {e}")
             return None
         cands = self.routable_replicas
+        if freq.leg == "prefill":
+            cands = [r for r in cands if self._role(r) == "prefill"]
+            if not cands:
+                # the class vanished between plan and pick: degrade to
+                # the single-leg cold path instead of stalling
+                freq.leg = "direct"
+                cands = self.routable_replicas
+        if freq.leg in ("decode", "direct"):
+            stream = [r for r in cands if self._role(r) != "prefill"]
+            if stream:
+                cands = stream
         if not cands:
             return None
         best, best_score = None, None
         for r in cands:
-            cov = (r.prefix_coverage(freq.prompt)
-                   if use_affinity and self.affinity_weight else 0)
-            score = placement_score(cov, r.queue_depth,
+            dev = host = 0
+            if use_affinity and self.affinity_weight:
+                dev, host = self._coverage(r, freq.prompt)
+            score = placement_score(dev, r.queue_depth,
                                     self.affinity_weight,
-                                    self.queue_cost_tokens)
+                                    self.queue_cost_tokens,
+                                    host_covered_tokens=host,
+                                    promote_discount=self.promote_discount)
             if best_score is None or score > best_score:
                 best, best_score = r, score
         with trace_span("fleet/route", request=freq.req_id,
-                        replica=best.replica_id,
+                        replica=best.replica_id, leg=freq.leg,
                         affinity=int(use_affinity),
                         queue_depth=best.queue_depth):
             return best
@@ -309,14 +443,32 @@ class FleetRouter:
     def _submit_to(self, target: ReplicaHandle,
                    freq: FleetRequest) -> None:
         freq.replica = target
-        spec = SubmitSpec(
-            prompt=freq.prompt, max_new_tokens=freq.max_new_tokens,
-            eos_token_id=freq.eos_token_id, deadline_s=freq.deadline_s,
-            temperature=freq.temperature, top_k=freq.top_k,
-            top_p=freq.top_p, seed=freq.seed, tenant=freq.tenant,
-            on_token=self._make_stream_cb(freq),
-            key_override=freq.prng_key,
-            on_submitted=lambda req, f=freq: self._record_submit(f, req))
+        if freq.leg == "prefill":
+            # leg 1: compute + publish only.  The client stream stays
+            # untouched (no tokens flow); the internal callback turns
+            # the tokenless OK terminal into the decode-leg placement.
+            spec = SubmitSpec(
+                prompt=freq.prompt, max_new_tokens=1,
+                eos_token_id=freq.eos_token_id,
+                deadline_s=freq.deadline_s,
+                temperature=freq.temperature, top_k=freq.top_k,
+                top_p=freq.top_p, seed=freq.seed, tenant=freq.tenant,
+                on_token=self._make_prefill_cb(freq),
+                key_override=freq.prng_key,
+                on_submitted=lambda req, f=freq: self._record_submit(
+                    f, req),
+                prefill_only=True)
+        else:
+            spec = SubmitSpec(
+                prompt=freq.prompt, max_new_tokens=freq.max_new_tokens,
+                eos_token_id=freq.eos_token_id,
+                deadline_s=freq.deadline_s,
+                temperature=freq.temperature, top_k=freq.top_k,
+                top_p=freq.top_p, seed=freq.seed, tenant=freq.tenant,
+                on_token=self._make_stream_cb(freq),
+                key_override=freq.prng_key,
+                on_submitted=lambda req, f=freq: self._record_submit(
+                    f, req))
         target.submit(spec)
 
     def _record_submit(self, freq: FleetRequest, req: Request) -> None:
@@ -332,6 +484,45 @@ class FleetRouter:
         def _cb(ev: TokenEvent) -> None:
             self._on_stream_event(freq, ev)
         return _cb
+
+    def _make_prefill_cb(self, freq: FleetRequest) -> Callable:
+        def _cb(ev: TokenEvent) -> None:
+            self._on_prefill_event(freq, ev)
+        return _cb
+
+    def _on_prefill_event(self, freq: FleetRequest,
+                          ev: TokenEvent) -> None:
+        """Leg-1 feedback.  A prefill leg emits no tokens — only a
+        tokenless terminal: OK hands off to the decode class (same
+        pinned key, so the stream is exactly what a single replica
+        would have produced); SHED re-enters the normal backoff; any
+        other terminal (deadline, quarantine, fatal fault) degrades to
+        a decode-side cold recompute — the fabric can only ever cost a
+        recompute, never a wrong token or a stall."""
+        with self._lock:
+            if freq.status is not None or freq.prefill_done:
+                return
+            if not ev.final:
+                return
+            if ev.status is RequestStatus.OK:
+                freq.prefill_done = True
+                freq.leg = "decode"
+                freq.prefill_replica_id = getattr(
+                    freq.replica, "replica_id", None)
+                freq.replica = None
+                freq.engine_req = None
+                self._m_handoffs.inc()
+                self.fleet_counts["handoffs"] += 1
+                self._try_place(freq)
+            elif ev.status is RequestStatus.SHED:
+                self._absorb_shed(freq, ev.request)
+            else:
+                freq.leg = "direct"
+                freq.replica = None
+                freq.engine_req = None
+                self._m_prefill_degraded.inc()
+                self.fleet_counts["prefill_degraded"] += 1
+                self._try_place(freq)
 
     def _on_stream_event(self, freq: FleetRequest,
                          ev: TokenEvent) -> None:
@@ -449,6 +640,11 @@ class FleetRouter:
         self._m_dead.inc()
         with self._lock:
             self.fleet_counts["dead_replicas"] += 1
+            # a dead prefill worker's unclaimed fabric entries are
+            # orphans: mid-publish chains are prefix-contiguous (never
+            # half-written), so sweeping them costs at most a recompute
+            # on the decode legs that still wanted them
+            self._reap_publisher(dead)
             victims = [f for f in self.requests
                        if f.status is None and f.replica is dead]
             if self._fr.enabled:
@@ -538,8 +734,39 @@ class FleetRouter:
                 self._m_drains.inc()
                 with self._lock:
                     self.fleet_counts["drains"] += 1
+                # a retired publisher leaves no fabric debris behind:
+                # whatever it published and nobody claimed is reaped now
+                self._reap_publisher(r)
         self._publish_gauges()
         return r
+
+    def _reap_publisher(self, r: ReplicaHandle) -> int:
+        """Sweep the fabric entries ``r`` published that nobody ever
+        claimed (no-op for non-prefill replicas and fabric-less
+        fleets)."""
+        if (self._role(r) != "prefill"
+                or self.shared_host_cache is None):
+            return 0
+        pid = getattr(r.srv, "publisher_id", r.replica_id)
+        n = self.shared_host_cache.reap_orphans(pid)
+        if n:
+            self._m_orphans_reaped.inc(n)
+            with self._lock:   # RLock: safe from the _failover holder
+                self.fleet_counts["orphans_reaped"] += n
+        return n
+
+    def reap_orphans(self) -> int:
+        """Sweep EVERY published-never-claimed fabric entry — the
+        end-of-run (or operator-driven) guarantee that a drained fleet
+        leaves zero orphaned fabric entries behind."""
+        if self.shared_host_cache is None:
+            return 0
+        n = self.shared_host_cache.reap_orphans()
+        if n:
+            self._m_orphans_reaped.inc(n)
+            with self._lock:
+                self.fleet_counts["orphans_reaped"] += n
+        return n
 
     def join(self, handle: ReplicaHandle) -> ReplicaHandle:
         """Live join: a cold replica becomes routable.  Build its

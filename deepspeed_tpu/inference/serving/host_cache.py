@@ -30,17 +30,31 @@ property test):
     host-side and in the device radix index;
   * every tier slot is exactly one of free or owned by one digest.
 
+Disaggregated serving (docs/serving.md "Disaggregated fleet &
+autoscaling") reuses this cache as the **KV fabric** between replica
+classes: a prefill worker publishes a finished
+chain (same digest keys, same codec bytes, plus a crc32 fingerprint and
+a publisher id), and a decode replica claims it through the ordinary
+promote path.  A published entry that fails its crc on claim is dropped
+and reads as a cold miss — never served; entries a dead or drained
+publisher left behind are swept by :meth:`HostTierCache.reap_orphans`.
+
 Like the allocator, this module is pure host code (numpy + slot
 stores, no jax, no observability imports): counters are plain ints the
-serving engine polls into the metrics registry.
+serving engine polls into the metrics registry.  The only resilience
+import is the deterministic fault-injection hook on the fabric
+endpoints (same precedent as the allocator's serving sites).
 """
 from __future__ import annotations
 
+import zlib
 from collections import OrderedDict
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ...runtime.resilience.errors import FatalIOError, TransientIOError
+from ...runtime.resilience.fault_injection import get_fault_injector
 from ...runtime.swap_tensor.slot_store import SlotStore, make_slot_store
 from .block_allocator import blocks_for_budget, kv_block_bytes
 
@@ -276,10 +290,17 @@ class HostTierCache:
                                         buffer_count=buffer_count,
                                         io_policy=io_policy, name=name),
                 nvme_slots))
+        # fabric bookkeeping: digests pushed by a prefill publisher and
+        # not yet claimed, with (publisher, crc32) for integrity + reaping
+        self._published: Dict[bytes, Tuple[Optional[str], int]] = {}
         # cumulative stats, engine-polled (plain ints, no obs imports)
         self.spills_total = 0        # blocks demoted out of HBM into here
         self.demotions_total = 0     # dram -> nvme pressure moves
         self.evictions_total = 0     # aged out of the machine entirely
+        self.published_total = 0     # fabric publishes (distinct inserts)
+        self.orphans_reaped_total = 0    # published-never-claimed sweeps
+        self.corrupt_dropped_total = 0   # crc mismatch on claim -> dropped
+        self.claim_faults_total = 0      # injected/IO claim failures
         self.hits_total: Dict[str, int] = {t.name: 0 for t in self._tiers}
 
     # -- introspection ----------------------------------------------------
@@ -320,6 +341,27 @@ class HostTierCache:
         self.spills_total += 1
         self._insert(0, digest, payload)
 
+    def publish(self, digest: bytes, payload: np.ndarray,
+                publisher: Optional[str] = None) -> None:
+        """Fabric write: a prefill worker pushes one finished chain
+        block for a decode replica to claim.  Identical storage path to
+        :meth:`put`, plus a crc32 fingerprint verified at claim time and
+        a publisher id so :meth:`reap_orphans` can sweep what a dead
+        worker left behind.  The ``serving.fabric.publish`` fault site
+        fires BEFORE any state mutation — a faulted publish leaves the
+        fabric exactly as it was and the caller degrades to decode-side
+        recompute."""
+        get_fault_injector().check("serving.fabric.publish")
+        crc = zlib.crc32(np.asarray(payload, np.uint8).tobytes())
+        for t in self._tiers:
+            if digest in t.lru:              # refresh + re-mark published
+                t.lru.move_to_end(digest)
+                self._published[digest] = (publisher, crc)
+                return
+        self.published_total += 1
+        self._published[digest] = (publisher, crc)
+        self._insert(0, digest, payload)
+
     def release_claim(self, digest: bytes, payload: np.ndarray) -> None:
         """A claimed promotion was cancelled before landing (request
         freed / preempted mid-admission): give the bytes back so the
@@ -335,6 +377,7 @@ class HostTierCache:
         the last tier's LRU entry is dropped."""
         if tier_idx >= len(self._tiers):
             self.evictions_total += 1        # nowhere colder to go
+            self._published.pop(digest, None)
             return
         t = self._tiers[tier_idx]
         if not t.free_slots:
@@ -354,12 +397,35 @@ class HostTierCache:
         """Remove ``digest``'s entry and return its payload (None on
         miss).  The caller owns the bytes until they land in the pool
         (then simply dropped) or the promotion is cancelled
-        (:meth:`release_claim`)."""
+        (:meth:`release_claim`).
+
+        Failure semantics make every fabric fault indistinguishable
+        from a cold miss: a transient fault on the
+        ``serving.fabric.claim`` site returns None and leaves the entry
+        resident (a later claim may succeed); a fatal fault discards
+        the entry AND returns None, so a suspect payload is never
+        served — the caller recomputes.  A published entry whose crc32
+        no longer matches its payload is likewise dropped, counted, and
+        reported as a miss."""
+        try:
+            get_fault_injector().check("serving.fabric.claim")
+        except TransientIOError:
+            self.claim_faults_total += 1
+            return None
+        except FatalIOError:
+            self.claim_faults_total += 1
+            self.discard(digest)
+            return None
         for t in self._tiers:
             slot = t.lru.pop(digest, None)
             if slot is not None:
                 payload = t.store.read_slot(slot, self.entry_nbytes)
                 t.free_slots.append(slot)
+                pub = self._published.pop(digest, None)
+                if (pub is not None
+                        and zlib.crc32(payload.tobytes()) != pub[1]):
+                    self.corrupt_dropped_total += 1
+                    return None              # already removed: cold miss
                 self.hits_total[t.name] += 1
                 return payload
         return None
@@ -369,6 +435,7 @@ class HostTierCache:
         re-registered this digest (a sibling recomputed the same
         content), so the host copy is redundant; dropping it keeps the
         device/host residency disjoint."""
+        self._published.pop(digest, None)
         for t in self._tiers:
             slot = t.lru.pop(digest, None)
             if slot is not None:
@@ -376,13 +443,43 @@ class HostTierCache:
                 return True
         return False
 
+    # -- fabric bookkeeping -----------------------------------------------
+    def published_entries(self, publisher: Optional[str] = None) -> int:
+        """Published-and-not-yet-claimed entry count (for one publisher,
+        or fabric-wide) — nonzero after a drain means orphans leaked."""
+        return sum(1 for p, _ in self._published.values()
+                   if publisher is None or p == publisher)
+
+    def reap_orphans(self, publisher: Optional[str] = None) -> int:
+        """Sweep published entries nobody claimed — the debris a prefill
+        worker leaves when it dies or drains mid-handoff.  Publishes are
+        prefix-contiguous per chain, so an orphan is never a half-written
+        claimable entry, just unreferenced bytes; reaping frees the
+        slots and a decode replica that still wanted the chain sees a
+        cold miss and recomputes."""
+        victims = [d for d, (p, _) in self._published.items()
+                   if publisher is None or p == publisher]
+        reaped = 0
+        for d in victims:
+            if self.discard(d):
+                reaped += 1
+        self.orphans_reaped_total += reaped
+        return reaped
+
     # -- invariants / teardown --------------------------------------------
     def assert_consistent(self,
                           device_digests: Optional[Set[bytes]] = None
                           ) -> None:
         """Slot accounting and cross-tier disjointness; with
         ``device_digests`` (the allocator's registered hashes) also the
-        hierarchy-wide rule that a digest lives in at most one place."""
+        hierarchy-wide rule that a digest lives in at most one place.
+        Published (fabric-transport) entries are exempt from the
+        device/host cross-check: a publisher's copy intentionally
+        coexists with device copies on OTHER replicas until claimed,
+        and content addressing makes the bytes identical by
+        construction — the spill/promote disjointness that guards
+        single-replica bookkeeping still holds for every non-published
+        entry."""
         seen: Dict[bytes, str] = {}
         for t in self._tiers:
             n_slots = t.store.n_slots
@@ -400,8 +497,13 @@ class HostTierCache:
                     raise AssertionError(
                         f"digest resident in both {seen[d]} and {t.name}")
                 seen[d] = t.name
+        dangling = set(self._published) - set(seen)
+        if dangling:
+            raise AssertionError(
+                f"{len(dangling)} published digest(s) tracked but not "
+                f"resident in any tier")
         if device_digests is not None:
-            both = set(seen) & device_digests
+            both = (set(seen) - set(self._published)) & device_digests
             if both:
                 raise AssertionError(
                     f"{len(both)} digest(s) resident both host-side and "

@@ -167,6 +167,10 @@ class Request:
     #: serving 503's Retry-After header).  None on every other terminal
     #: status, and on sheds before the engine has a rate estimate.
     retry_after_s: Optional[float] = None
+    #: disaggregated-fleet prefill leg: compute (and publish) the
+    #: prompt's KV, emit NO tokens, and finish OK the moment prefill
+    #: completes — the decode leg streams on another replica
+    prefill_only: bool = False
 
     @property
     def prefix(self) -> List[int]:
@@ -653,4 +657,16 @@ class ContinuousBatchingScheduler:
         # them instead of re-prefilling
         self.alloc.commit_cached(req.req_id, req.prefix, req.cached_tokens)
         self.alloc.free(req.req_id)
+        return self._terminalize(req, RequestStatus.OK)
+
+    def finish_prefill(self, slot: int) -> Request:
+        """OK-finish a ``prefill_only`` request the moment its prefill
+        target lands.  The engine has already published the chain to
+        the KV fabric, so the blocks are freed WITH unregistration
+        (``discard=True``): the digests must live only fabric-side —
+        parking them in this replica's cached LRU too would violate the
+        cross-tier disjointness the promote path depends on."""
+        req = self.running.pop(slot)
+        self._admit_order.remove(slot)
+        self.alloc.free(req.req_id, discard=True)
         return self._terminalize(req, RequestStatus.OK)

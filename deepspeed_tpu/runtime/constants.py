@@ -231,6 +231,23 @@ SERVING_FLEET_MAX_FAILOVERS_DEFAULT = 3
 # routable replica is saturated (retry_call-shaped schedule)
 SERVING_FLEET_RETRY_BASE_DELAY_S_DEFAULT = 0.05
 SERVING_FLEET_RETRY_MAX_DELAY_S_DEFAULT = 2.0
+# disaggregated serving (docs/serving.md "Disaggregated fleet &
+# autoscaling"): the first K replicas become prefill workers that
+# publish finished chains into the shared host tier (the KV fabric) and
+# the rest decode replicas that claim-and-promote them; 0 keeps the
+# uniform fleet.  Requires serving.host_cache.enabled when > 0.
+SERVING_FLEET_PREFILL_REPLICAS_DEFAULT = 0
+# affinity credit for a host/fabric-resident prefix token relative to a
+# device-resident one: it saves the recompute but pays claim + promote
+SERVING_FLEET_PROMOTE_DISCOUNT_DEFAULT = 0.5
+# autoscaler policy (fleet/autoscaler.py): burn-rate alerts + per-class
+# queue depth -> join/drain, bounded by cooldowns and the chip budget
+SERVING_FLEET_CHIP_BUDGET_DEFAULT = 8       # alive replicas x chips each
+SERVING_FLEET_SCALE_UP_COOLDOWN_S_DEFAULT = 5.0
+SERVING_FLEET_SCALE_DOWN_COOLDOWN_S_DEFAULT = 30.0
+SERVING_FLEET_QUEUE_HIGH_DEFAULT = 8.0      # per-replica depth -> scale up
+SERVING_FLEET_QUEUE_LOW_DEFAULT = 1.0       # below this the class is quiet
+SERVING_FLEET_QUIET_S_DEFAULT = 10.0        # quiet this long -> scale down
 
 # Training hot-path block (``training`` — runtime/config.py
 # TrainingConfig, docs/training_perf.md): per-run overrides of the model

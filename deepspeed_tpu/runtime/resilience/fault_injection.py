@@ -20,6 +20,9 @@ instrumented code paths:
     serving.promote        one host-tier block scatter back to the pool
     serving.fleet.route    one fleet placement decision
     serving.fleet.replica_step  one fleet replica's engine iteration
+    serving.fabric.publish one prefill-worker KV-fabric chain-block publish
+    serving.fabric.claim   one decode-replica KV-fabric claim
+    serving.fleet.scale    one autoscaler join/drain actuation
 
 The serving sites feed the continuous-batching chaos suite
 (tests/unit/test_serving_chaos.py, docs/serving.md "Failure handling"):

@@ -59,7 +59,7 @@ for f in tests/unit/test_*.py; do
   if [[ "$f" == *test_resilience.py || "$f" == *test_observability.py \
         || "$f" == *test_serving.py || "$f" == *test_serving_tp.py \
         || "$f" == *test_frontend.py || "$f" == *test_host_cache.py \
-        || "$f" == *test_fleet.py \
+        || "$f" == *test_fleet.py || "$f" == *test_disagg_fleet.py \
         || "$f" == *test_training_perf.py ]]; then
     continue   # each runs once in its marker sweep below, not twice
   fi
@@ -200,6 +200,48 @@ if [[ -z "$FILTER" || "fleet" == *"$FILTER"* || "serving" == *"$FILTER"* ]]; the
       PASSED=$((PASSED + 1))
     else
       FAILED+=("fleet-chaos [DSTPU_FAULTS=${faults}]")
+    fi
+  done
+fi
+
+# Disaggregated-fleet sweep: the `disagg`-marked suite — KV-fabric
+# publish/claim units (crc-guarded corruption drop, fault-before-
+# mutation, publisher-scoped orphan reaping), fabric-credit placement
+# pins, autoscaler policy on synthetic clocks (scale-up before the
+# breach, cooldown-gated quiet-tail scale-down, chip-budget denial,
+# never-drain-last, bounded alert storms), and the two-leg engine
+# end-to-ends: token-exact prefill->decode handoff vs generate(),
+# publish/claim fault degradation to recompute, drain/death leaving
+# zero orphaned fabric entries (pytest.ini `disagg` marker;
+# docs/serving.md "Disaggregated fleet & autoscaling"). The
+# chaos-marked disagg wave is then replayed across its own
+# DSTPU_FAULTS matrix: a transient publish plan (prefill legs degrade
+# to decode-side recompute), a fatal claim plan (the published entry
+# is quarantined, the decode replica recomputes), and a fatal
+# scale-actuator plan (the autoscaler abandons the action and charges
+# the cooldown) — every stream must stay token-exact with the fabric
+# orphan-free.
+if [[ -z "$FILTER" || "disagg" == *"$FILTER"* || "serving" == *"$FILTER"* ]]; then
+  echo "=== disaggregated-fleet marker sweep (pytest -m disagg)"
+  if JAX_PLATFORMS=cpu python -m pytest tests/unit/test_disagg_fleet.py \
+       -m disagg -q --tb=short ${EXTRA_PYTEST_ARGS:-}; then
+    PASSED=$((PASSED + 1))
+  else
+    FAILED+=("pytest -m disagg")
+  fi
+  DISAGG_CHAOS_MATRIX=(
+    "serving.fabric.publish=fail:1:2"
+    "serving.fabric.claim=fatal:1:1"
+    "serving.fleet.scale=fatal:1:1"
+  )
+  for faults in "${DISAGG_CHAOS_MATRIX[@]}"; do
+    echo "=== disagg-chaos sweep (DSTPU_FAULTS='${faults}')"
+    if DSTPU_FAULTS="$faults" JAX_PLATFORMS=cpu python -m pytest \
+         tests/unit/test_disagg_fleet.py -m chaos -q --tb=short \
+         ${EXTRA_PYTEST_ARGS:-}; then
+      PASSED=$((PASSED + 1))
+    else
+      FAILED+=("disagg-chaos [DSTPU_FAULTS=${faults}]")
     fi
   done
 fi

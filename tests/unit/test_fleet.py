@@ -600,3 +600,72 @@ def test_fleet_chaos_wave(env_injector):
     fleet.run()
     assert all(f.status is RequestStatus.OK for f in extra)
     assert_wave_exact(eng, fleet, wave[:2], extra, extra_sinks)
+
+
+# ---------------------------------------------------------------------------
+# satellite (ISSUE 16): the high-water mark must survive a SECOND failover
+# ---------------------------------------------------------------------------
+def test_stream_deduper_survives_double_failover_replay():
+    """Regression: after a first failover's replay + new progress, a
+    second failover replays the union of both deliveries — the mark
+    must reflect everything the client has seen, not just the first
+    replica's output."""
+    d = StreamDeduper()
+    for i, tok in enumerate([5, 7, 9]):
+        assert d.admit(ev(tok, i)) is not None
+    # first failover: full replay swallowed, then new progress
+    for i, tok in enumerate([5, 7, 9]):
+        assert d.admit(ev(tok, i)) is None
+    assert d.admit(ev(11, 3)) is not None
+    assert d.high_water == 4
+    # second failover: the replay now spans BOTH replicas' deliveries
+    for i, tok in enumerate([5, 7, 9, 11]):
+        assert d.admit(ev(tok, i)) is None
+    assert d.admit(ev(13, 4)) is not None
+    assert d.delivered == [5, 7, 9, 11, 13]
+    assert d.high_water == 5 and d.duplicates == 7
+
+
+def _kill_on_next_step(fleet, injector, target):
+    """Arm a fatal so ``target`` dies on ITS next iteration: site calls
+    advance once per live replica per pump, in replica-list order."""
+    stepping = [r for r in fleet.replicas
+                if r.state in (ReplicaState.HEALTHY, ReplicaState.DRAINING)]
+    pos = stepping.index(target) + 1
+    calls = injector.calls.get("serving.fleet.replica_step", 0)
+    injector.add_plan("serving.fleet.replica_step", "fatal",
+                      at=calls + pos)
+
+
+@pytest.mark.slow
+def test_fleet_double_failover_token_exact(injector):
+    """Kill the replica serving a request, then kill the replica its
+    replay landed on: the twice-failed-over stream is still
+    token-identical to generate() with exactly-once delivery — the
+    second replay dedupes against the union high-water mark."""
+    eng = fleet_engine(replicas=3)
+    fleet = FleetRouter.from_engine(eng, rng=jax.random.PRNGKey(0))
+    reqs, sinks = submit_wave(fleet, WAVE)
+    fleet.pump()
+    fleet.pump()                          # tokens flowing on all replicas
+    target = next(f for f in reqs if f.status is None)
+    first = target.replica
+    _kill_on_next_step(fleet, injector, first)
+    fleet.pump()                          # death + failover in one round
+    assert first.state is ReplicaState.DEAD
+    assert target.failovers == 1 and target.replica is not first
+    fleet.pump()                          # the replay makes progress
+    assert target.status is None, "kill window closed too fast"
+    second = target.replica
+    _kill_on_next_step(fleet, injector, second)
+    fleet.pump()
+    assert second.state is ReplicaState.DEAD
+    assert target.failovers == 2
+    fleet.run()
+    assert fleet.fleet_counts["dead_replicas"] == 2
+    assert all(f.status is RequestStatus.OK for f in reqs)
+    assert_wave_exact(eng, fleet, WAVE, reqs, sinks)
+    # the double failover kept the ORIGINAL fold-in key end to end
+    assert tuple(target.engine_req.prng_key) == target.prng_key
+    assert target.replica.replica_id not in (first.replica_id,
+                                             second.replica_id)

@@ -435,6 +435,7 @@ def make_draft(vocab=64, d_model=32, heads=4):
     return draft, draft.init(jax.random.PRNGKey(1))
 
 
+@pytest.mark.slow
 def test_spec_streams_token_exact_vs_plain():
     """The acceptance pin: with an (untrained) draft armed, every
     emitted stream — mixed greedy and sampled — is byte-identical to
@@ -483,6 +484,7 @@ def _mesh_run(mesh, params, draft=None, dparams=None):
     return eng.params, [r.output for r in reqs]
 
 
+@pytest.mark.slow
 def test_mesh_shape_determinism_sampled():
     """The same seeded sampled workload on (1,1) and (2,2) meshes emits
     token-identical streams — the fold_in keys and the partitionable

@@ -278,6 +278,7 @@ class TestOnebitLambEngine:
             {"dcn_data": 2, "data": 4}, n=3)
         np.testing.assert_allclose(ref, ob, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_compression_phase_trains(self):
         engine, losses = _train(
             {"type": "OnebitLamb", "params": {"lr": 1e-3,

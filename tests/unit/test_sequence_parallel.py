@@ -190,6 +190,7 @@ class TestSequenceParallelTraining:
             ["loss"]) for _ in range(2)]
         assert all(np.isfinite(losses))
 
+    @pytest.mark.slow
     def test_long_sequence_2k(self):
         """A 2048-token step through ring attention (8-way sequence) —
         the long-context configuration on the virtual mesh."""
