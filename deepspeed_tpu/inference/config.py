@@ -202,7 +202,7 @@ class ServingConfig(ConfigModel):
     (num_kv_blocks - 1) * kv_block_size must cover the target batch's
     prompts + generations or the scheduler will (correctly) queue and
     preempt."""
-    enabled: bool = False
+    enabled: bool = C.SERVING_ENABLED_DEFAULT
     kv_block_size: int = C.SERVING_KV_BLOCK_SIZE_DEFAULT
     num_kv_blocks: int = C.SERVING_NUM_KV_BLOCKS_DEFAULT
     max_batch_slots: int = C.SERVING_MAX_BATCH_SLOTS_DEFAULT

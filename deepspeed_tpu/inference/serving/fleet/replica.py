@@ -46,6 +46,19 @@ class ReplicaState(enum.Enum):
     DEAD = "dead"            # ServingError / injected fatal / stale beat
 
 
+#: legal lifecycle edges — the single source FLEET001/002 validate
+#: every ``.state = ReplicaState.X`` assignment against.  A replica
+#: that jumps STARTING → DRAINING never drains its queue; a RETIRED
+#: one resurrected by a stray write double-serves failed-over streams.
+_TRANSITIONS = {
+    ReplicaState.STARTING: (ReplicaState.HEALTHY, ReplicaState.DEAD),
+    ReplicaState.HEALTHY: (ReplicaState.DRAINING, ReplicaState.DEAD),
+    ReplicaState.DRAINING: (ReplicaState.RETIRED, ReplicaState.DEAD),
+    ReplicaState.RETIRED: (),
+    ReplicaState.DEAD: (),
+}
+
+
 @dataclasses.dataclass
 class SubmitSpec:
     """One router→replica submission, carried through the inbox so a

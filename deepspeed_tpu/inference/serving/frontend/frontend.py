@@ -87,9 +87,11 @@ class ServingFrontend:
         return self.srv.submit(prompt, tenant=tenant, **kw)
 
     def _active_tenants(self) -> List[str]:
+        # sorted, not list: the active-tenant order feeds the fair-share
+        # scheduler's tie-breaks, and set order varies per process
         sched = self.srv.scheduler
-        return list({r.tenant for r in sched.waiting}
-                    | {r.tenant for r in sched.running.values()})
+        return sorted({r.tenant for r in sched.waiting}
+                      | {r.tenant for r in sched.running.values()})
 
     # -- scheduler policies ------------------------------------------------
     def _order_admissions(self, waiting: Deque[Request]) -> None:

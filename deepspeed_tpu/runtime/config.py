@@ -416,9 +416,9 @@ class ObsMetricsConfig(ConfigModel):
     MonitorMaster fan-out (TB/CSV/W&B) when a monitor is enabled."""
     enabled: bool = C.OBSERVABILITY_METRICS_ENABLED_DEFAULT
     # node_exporter textfile-collector directory (dstpu_rank<r>.prom)
-    prometheus_dir: Optional[str] = None
+    prometheus_dir: Optional[str] = C.OBSERVABILITY_PROMETHEUS_DIR_DEFAULT
     # JSON snapshot path
-    json_path: Optional[str] = None
+    json_path: Optional[str] = C.OBSERVABILITY_JSON_PATH_DEFAULT
     # export every N steps (0 = only at flush/close/atexit)
     export_interval_steps: int = C.OBSERVABILITY_EXPORT_INTERVAL_DEFAULT
 

@@ -110,6 +110,8 @@ OBSERVABILITY_TRACE_BUFFER_DEFAULT = 65536      # ring capacity, spans
 OBSERVABILITY_TRACE_DIR_DEFAULT = "traces"
 OBSERVABILITY_METRICS_ENABLED_DEFAULT = False
 OBSERVABILITY_EXPORT_INTERVAL_DEFAULT = 0       # steps; 0 = flush-only
+OBSERVABILITY_PROMETHEUS_DIR_DEFAULT = None     # textfile-collector dir
+OBSERVABILITY_JSON_PATH_DEFAULT = None          # JSON snapshot path
 # request-scoped tracing (observability/request_trace.py): per-request
 # serving timelines exported as extra Perfetto tracks in the span trace
 OBSERVABILITY_REQUEST_TRACE_ENABLED_DEFAULT = False
@@ -137,6 +139,7 @@ OBSERVABILITY_FLIGHT_MAX_BUNDLES_DEFAULT = 4    # bundles kept per rank
 # of the INFERENCE config (inference/config.py ServingConfig,
 # inference/serving/, docs/serving.md). Declared here so the whole JSON
 # schema stays in one file (dstpu-lint CFG rules).
+SERVING_ENABLED_DEFAULT = False         # serving engine is opt-in
 SERVING_KV_BLOCK_SIZE_DEFAULT = 16      # tokens per paged KV block
 SERVING_NUM_KV_BLOCKS_DEFAULT = 512     # pool blocks (block 0 reserved)
 SERVING_MAX_BATCH_SLOTS_DEFAULT = 8     # compiled decode-batch width

@@ -13,10 +13,10 @@ import time
 from typing import List, Optional
 
 from .baseline import Baseline
-from .core import Finding, lint_paths
+from .core import Finding
 
 FAMILIES = ("SYNC", "TRACE", "LOCK", "CFG", "TEST", "PALLAS", "MESH",
-            "LIFE")
+            "LIFE", "DET", "FLEET", "DRIFT")
 
 RULE_CATALOG = {
     "SYNC001": "`.item()` device→host sync in a hot path",
@@ -51,7 +51,24 @@ RULE_CATALOG = {
                "parallel/shard_map_compat",
     "LIFE001": "allocator allocate/fork with no reachable free",
     "LIFE002": "terminal RequestStatus stamped outside _terminalize()",
-    "LIFE003": "FaultInjector site missing from the documented catalog",
+    "DET001": "ad-hoc randomness (random.*/np.random/unpinned PRNGKey) "
+              "in serving code",
+    "DET002": "set iterated into an order-sensitive sink "
+              "(digest/score/ordering) — wrap in sorted()",
+    "DET003": "wall-clock read in a function with an injectable clock "
+              "parameter",
+    "DET004": "dict .values()/.items() iteration that mutates the dict "
+              "mid-loop",
+    "DRIFT001": "metric registered in code but absent from every docs "
+                "table",
+    "DRIFT002": "metric named in a docs table that no code registers",
+    "DRIFT003": "FaultInjector site missing from docs/resilience.md or "
+                "the run_tests.sh chaos matrices (subsumes LIFE003)",
+    "DRIFT004": "serving.*/observability.* config key drift between "
+                "dataclasses, constants and docs tables",
+    "FLEET001": "ReplicaState transition not guarded per _TRANSITIONS",
+    "FLEET002": "terminal ReplicaState stamped outside the lifecycle "
+                "owner",
 }
 
 
@@ -99,11 +116,24 @@ def _build_parser() -> argparse.ArgumentParser:
                         "(printed by default so the report always "
                         "carries rule IDs and file:line)")
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--no-cache", action="store_true",
+                   help="bypass the incremental cache: full re-analysis, "
+                        "nothing read or written")
+    p.add_argument("--cache-file", default=None, metavar="PATH",
+                   help="incremental cache location (default: "
+                        "<root>/.dstpu_lint_cache.json)")
+    p.add_argument("--changed", action="store_true",
+                   help="report only findings in files changed vs HEAD "
+                        "(git diff + untracked); analysis still covers "
+                        "everything so cross-file rules stay sound")
+    p.add_argument("--fix", action="store_true",
+                   help="apply mechanical autofixes (DET002 sorted() "
+                        "wrap, DRIFT001 docs-row stubs), then re-lint")
     return p
 
 
 def _summary_line(findings: List[Finding], new: List[Finding],
-                  dt: float) -> str:
+                  dt: float, cache_note: str = "") -> str:
     per_family = {fam: [0, 0] for fam in FAMILIES}
     for f in findings:
         per_family.setdefault(f.family, [0, 0])
@@ -116,7 +146,7 @@ def _summary_line(findings: List[Finding], new: List[Finding],
         for fam, (tot, nw) in per_family.items())
     return (f"dstpu-lint: {len(findings)} finding(s), "
             f"{len(new)} new, {len(findings) - len(new)} baselined "
-            f"[{dt:.1f}s]\n  {fams}")
+            f"[{dt:.1f}s{cache_note}]\n  {fams}")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -170,24 +200,53 @@ def main(argv: Optional[List[str]] = None) -> int:
         rules = tuple(r.strip() for r in args.rules.split(",")
                       if r.strip())
 
+    from .engine import EngineStats, changed_paths, lint_paths_cached
+
+    def _run() -> Optional[List[Finding]]:
+        errors: List[str] = []
+        try:
+            got = lint_paths_cached(
+                paths, root=root, rules=rules,
+                check_markers=args.check_markers,
+                tests_dir=args.tests_dir, pytest_ini=args.pytest_ini,
+                errors=errors, min_severity=args.min_severity,
+                cache_file=args.cache_file, no_cache=args.no_cache,
+                stats=stats)
+        except RecursionError as e:  # pragma: no cover - pathological
+            print(f"dstpu-lint: internal error: {e}", file=sys.stderr)
+            return None
+        if errors:
+            # an unparsable file is unanalyzed coverage: its hazards AND
+            # its baselined findings silently vanish — that must fail
+            # the gate, not shrink it
+            for err in errors:
+                print(f"dstpu-lint: cannot parse: {err}", file=sys.stderr)
+            return None
+        return got
+
     t0 = time.perf_counter()
-    errors: List[str] = []
-    try:
-        findings = lint_paths(
-            paths, root=root, rules=rules,
-            check_markers=args.check_markers,
-            tests_dir=args.tests_dir, pytest_ini=args.pytest_ini,
-            errors=errors, min_severity=args.min_severity)
-    except RecursionError as e:  # pragma: no cover - pathological input
-        print(f"dstpu-lint: internal error: {e}", file=sys.stderr)
+    stats = EngineStats()
+    findings = _run()
+    if findings is None:
         return 2
-    if errors:
-        # an unparsable file is unanalyzed coverage: its hazards AND its
-        # baselined findings silently vanish — that must fail the gate,
-        # not shrink it
-        for err in errors:
-            print(f"dstpu-lint: cannot parse: {err}", file=sys.stderr)
-        return 2
+
+    if args.fix and findings:
+        from .fixes import apply_fixes
+        fixed = apply_fixes(root, findings)
+        for rel in sorted(fixed):
+            print(f"dstpu-lint: fixed {fixed[rel]} finding(s) in {rel}")
+        if fixed:
+            findings = _run()  # re-lint: fixes changed content hashes
+            if findings is None:
+                return 2
+
+    if args.changed:
+        changed = changed_paths(root)
+        if changed is None:
+            print("dstpu-lint: --changed needs git; reporting all "
+                  "findings", file=sys.stderr)
+        else:
+            findings = [f for f in findings if f.path in changed]
     dt = time.perf_counter() - t0
 
     if args.write_baseline:
@@ -223,7 +282,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.quiet:
         for f in old:
             print(f"base {f.render()}")
-    print(_summary_line(findings, new, dt))
+    cache_note = ""
+    if stats.total_modules:
+        cache_note = (f", {stats.reanalyzed}/{stats.total_modules} "
+                      f"analyzed")
+    print(_summary_line(findings, new, dt, cache_note))
     if new:
         print("dstpu-lint: FAIL — fix the new findings above, suppress "
               "a deliberate one with `# dstpu: ignore[RULE]`, or "

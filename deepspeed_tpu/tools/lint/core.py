@@ -365,11 +365,13 @@ def all_families():
     """(name, run-callable) per rule family — single source for
     ``lint_paths`` AND the per-family-equivalence pin in the tests."""
     from . import (rules_sync, rules_trace, rules_lock, rules_config,
-                   rules_pallas, rules_mesh, rules_life)
+                   rules_pallas, rules_mesh, rules_life, rules_det,
+                   rules_fleet, rules_drift)
     return [("SYNC", rules_sync.run), ("TRACE", rules_trace.run),
             ("LOCK", rules_lock.run), ("CFG", rules_config.run),
             ("PALLAS", rules_pallas.run), ("MESH", rules_mesh.run),
-            ("LIFE", rules_life.run)]
+            ("LIFE", rules_life.run), ("DET", rules_det.run),
+            ("FLEET", rules_fleet.run), ("DRIFT", rules_drift.run)]
 
 
 def lint_paths(paths: Sequence[str], root: Optional[str] = None,

@@ -1,0 +1,527 @@
+"""Incremental lint engine — content-hash-keyed per-module cache.
+
+``core.lint_paths`` re-parses and re-analyzes every module on every
+run.  At ~154 modules that is ~5s per invocation, which is fine for CI
+but hostile to the edit-lint loop.  The observation that makes
+incrementality safe is that after PR 17's refactor every family is
+either:
+
+  * **module-local given context** — SYNC/TRACE need only the hot-set
+    membership of the module's own functions (plus static_argnums of
+    external jit wraps targeting them); MESH needs the global axis set;
+    FLEET needs the transition table; LOCK/PALLAS/LIFE/DET need nothing
+    beyond the module — or
+  * **assembly-shaped** — CFG/DRIFT/TEST001 are cheap joins over
+    per-module facts plus docs/scripts that we simply recompute every
+    run.
+
+So the cache stores, per module keyed by its content hash:
+
+  * **facts** — the JSON summary global passes need: function call/ref
+    edges and jit-rootness (hot-set closure), jit-wrap targets and
+    static positions, metric/fault-site/config-class extractions,
+    constant identifiers, suppression markers, the axis/fleet-table
+    declarations
+  * **findings** — the module-attributed findings from the last run,
+    tagged with a **context fingerprint** (the module's hot/jit/root
+    memberships, external static positions, axes, fleet table)
+
+A warm run re-parses only modules whose content hash changed, rebuilds
+the global context from facts (cheap: no ASTs), and re-analyzes exactly
+the dirty modules plus modules whose context fingerprint moved (the
+dependents: wrap a function in ``jax.jit`` in module A and module B's
+callee goes jit-hot, so B re-analyzes even though B's text is
+unchanged).  Everything else replays cached findings verbatim.
+
+A cold run is a warm run with an empty cache — both execute the same
+per-module path, so cold and warm outputs are byte-identical by
+construction, which the test suite pins.
+
+The cache lives at ``<root>/.dstpu_lint_cache.json`` (gitignored) and
+is keyed by ``engine_version()`` — a hash of the lint package's own
+sources — so editing any rule invalidates everything.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import (Finding, Project, Severity, SourceModule,
+                   collect_py_files, get_symtab)
+from . import hotpath
+from .hotpath import FuncKey
+
+CACHE_BASENAME = ".dstpu_lint_cache.json"
+
+
+def engine_version() -> str:
+    """Hash of the lint package's own sources — any rule edit
+    invalidates the whole cache (stale findings are worse than a cold
+    run)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for fn in sorted(os.listdir(here)):
+        if not fn.endswith(".py"):
+            continue
+        with open(os.path.join(here, fn), "rb") as f:
+            h.update(fn.encode())
+            h.update(f.read())
+    return h.hexdigest()
+
+
+def _sha(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# per-module fact extraction (runs only on dirty modules)
+# ---------------------------------------------------------------------------
+def _key_list(keys: Iterable[FuncKey]) -> List[List[str]]:
+    return sorted([k[0], k[1]] for k in keys)
+
+
+def extract_facts(mod: SourceModule, symtab) -> Dict[str, object]:
+    """The JSON-serializable summary every global pass needs.  Must be
+    derivable from the module alone — anything context-dependent
+    belongs in the fingerprint, not here."""
+    from . import (rules_config, rules_det, rules_drift, rules_fleet,
+                   rules_mesh)
+    idx = symtab.index(mod)
+    funcs, wraps = hotpath.collect_module(mod, idx)
+    facts: Dict[str, object] = {
+        "modname": mod.modname,
+        "funcs": {
+            q: [info.name, _key_list(info.calls), _key_list(info.refs),
+                bool(info.jit_root)]
+            for (_m, q), info in sorted(funcs.items())
+        },
+        "wraps": sorted(
+            [[list(w.target) if w.target else None,
+              sorted(w.static_positions)] for w in wraps], key=repr),
+        "metrics": rules_drift.extract_metrics(mod, symtab),
+        "sites": rules_drift.extract_sites(mod, symtab),
+        "config_classes": rules_drift.extract_config_classes(mod),
+        "const_ids": sorted(
+            n for n in (symtab.attr_names[mod.rel] |
+                        symtab.name_ids[mod.rel])
+            if rules_config._CONST_RE.match(n)),
+        "suppress": {
+            str(ln): {"rules": sorted(ids),
+                      "comment_only":
+                          mod.lines[ln - 1].lstrip().startswith("#")
+                          if 0 < ln <= len(mod.lines) else False}
+            for ln, ids in mod.suppressions.items()
+        },
+    }
+    if mod.rel.endswith("runtime/constants.py"):
+        facts["consts"] = {
+            n: [v, line] for n, (v, line)
+            in rules_config._collect_constants(mod.tree).items()}
+    if mod.rel.endswith("runtime/config.py"):
+        facts["raw_keys"] = [
+            [v, node.lineno, node.col_offset]
+            for v, node in rules_config._raw_key_calls(mod.tree)]
+    if mod.rel.endswith(rules_mesh.TOPOLOGY_REL):
+        axes = rules_mesh.declared_axes(Project(root="", modules=[mod]))
+        facts["axes"] = sorted(axes) if axes is not None else []
+    table = rules_fleet.transitions_table(mod)
+    if table is not None:
+        facts["fleet"] = {m: list(s) for m, s in table.items()}
+    return facts
+
+
+# ---------------------------------------------------------------------------
+# global context from facts
+# ---------------------------------------------------------------------------
+@dataclass
+class _WrapStub:
+    """Lightweight stand-in for a JitWrap from another module — TRACE001
+    only reads ``.target`` and ``.static_positions``."""
+    target: Optional[FuncKey]
+    static_positions: List[int]
+
+
+@dataclass
+class Context:
+    jit_roots: Set[FuncKey] = field(default_factory=set)
+    jit_hot: Set[FuncKey] = field(default_factory=set)
+    step_hot: Set[FuncKey] = field(default_factory=set)
+    #: (source rel, stub) for every jit wrap with a resolved target
+    wrap_stubs: List[Tuple[str, _WrapStub]] = field(default_factory=list)
+    axes: Optional[Set[str]] = None
+    fleet_table: Optional[Dict[str, Tuple[str, ...]]] = None
+    fleet_owner: str = ""
+
+
+def build_context(order: List[str],
+                  facts_by_rel: Dict[str, Dict[str, object]]) -> Context:
+    ctx = Context()
+    funcs_data: Dict[FuncKey, Tuple[str, Set[FuncKey], Set[FuncKey],
+                                    bool]] = {}
+    wrap_targets: List[FuncKey] = []
+    for rel in order:
+        facts = facts_by_rel[rel]
+        modname = str(facts["modname"])
+        for q, (name, calls, refs, jit_root) in sorted(
+                facts["funcs"].items()):  # type: ignore[union-attr]
+            funcs_data[(modname, q)] = (
+                str(name),
+                {(c[0], c[1]) for c in calls},
+                {(r[0], r[1]) for r in refs},
+                bool(jit_root))
+        for target, positions in facts["wraps"]:  # type: ignore
+            if target is not None:
+                key = (target[0], target[1])
+                wrap_targets.append(key)
+                ctx.wrap_stubs.append(
+                    (rel, _WrapStub(target=key,
+                                    static_positions=list(positions))))
+    ctx.jit_roots, ctx.jit_hot, ctx.step_hot = hotpath.compute_hot_sets(
+        funcs_data, wrap_targets)
+    for rel in order:  # first declarer wins, like Project.by_rel
+        if "axes" in facts_by_rel[rel]:
+            ctx.axes = set(facts_by_rel[rel]["axes"])  # type: ignore
+            break
+    for rel in order:
+        if "fleet" in facts_by_rel[rel]:
+            ctx.fleet_table = {
+                m: tuple(s) for m, s
+                in facts_by_rel[rel]["fleet"].items()}  # type: ignore
+            ctx.fleet_owner = rel
+            break
+    return ctx
+
+
+def fingerprint(rel: str, facts: Dict[str, object], ctx: Context) -> str:
+    """Everything outside the module's own text that can change its
+    findings.  A module whose sha AND fingerprint both match replays
+    cached findings; anything else re-analyzes."""
+    modname = str(facts["modname"])
+    own = {(modname, q) for q in facts["funcs"]}  # type: ignore
+    hot = sorted(
+        [q, (modname, q) in ctx.jit_hot, (modname, q) in ctx.jit_roots]
+        for q in facts["funcs"]  # type: ignore[union-attr]
+        if (modname, q) in ctx.step_hot)
+    static = sorted(
+        [stub.target[1], sorted(stub.static_positions)]
+        for _src_rel, stub in ctx.wrap_stubs
+        if stub.target in own and stub.static_positions)
+    fp = {
+        "hot": hot,
+        "static": static,
+        "axes": sorted(ctx.axes) if ctx.axes is not None else None,
+        "fleet": ([sorted(ctx.fleet_table.items()), ctx.fleet_owner]
+                  if ctx.fleet_table is not None else None),
+    }
+    return _sha(json.dumps(fp, sort_keys=True, default=list))
+
+
+# ---------------------------------------------------------------------------
+# per-module analysis — the ONE code path cold and warm runs share
+# ---------------------------------------------------------------------------
+def analyze_module(mod: SourceModule, ctx: Context, root: str,
+                   mini: Optional[Project] = None) -> List[Finding]:
+    from . import (rules_det, rules_life, rules_lock, rules_mesh,
+                   rules_pallas, rules_sync, rules_trace)
+    if mini is None:
+        mini = Project(root=root, modules=[mod])
+    symtab = get_symtab(mini)
+    funcs, own_wraps = hotpath.collect_module(mod, symtab.index(mod))
+    findings: List[Finding] = []
+    # SYNC/TRACE with hotness injected from context
+    for key, info in funcs.items():
+        if key in ctx.jit_roots:
+            info.jit_root = True
+    ext = [stub for src_rel, stub in ctx.wrap_stubs
+           if src_rel != mod.rel and stub.target in funcs]
+    for key in sorted(funcs):
+        info = funcs[key]
+        if key in ctx.step_hot:
+            rules_sync._check_func(info, in_jit=key in ctx.jit_hot,
+                                   findings=findings)
+    for key in sorted(funcs):
+        info = funcs[key]
+        if key in ctx.jit_hot:
+            if info.jit_root:
+                rules_trace._check_traced_branches(
+                    info, list(own_wraps) + list(ext), findings)
+            rules_trace._check_impure_calls(info, findings)
+    rules_trace._check_retrace(own_wraps, findings)
+    rules_trace._check_static_hashability(mini, own_wraps, findings)
+    # module-local families
+    findings += rules_lock.run(mini)
+    findings += rules_pallas.run(mini)
+    findings += rules_life.run(mini)
+    findings += rules_mesh.run(mini, axes=ctx.axes)
+    rules_det.run_module(mod, symtab, findings)
+    if ctx.fleet_table is not None:
+        from . import rules_fleet
+        rules_fleet.check_module(mod, ctx.fleet_table, ctx.fleet_owner,
+                                 findings)
+    return [f for f in findings if not mod.suppressed(f)]
+
+
+# ---------------------------------------------------------------------------
+# assembly passes (recomputed every run from facts — cheap, no ASTs)
+# ---------------------------------------------------------------------------
+def _assemble_global(order: List[str],
+                     facts_by_rel: Dict[str, Dict[str, object]],
+                     root: str) -> List[Finding]:
+    from . import rules_config, rules_drift
+    findings: List[Finding] = []
+    # CFG — constants vs consumption vs raw parser keys
+    consts_rel = next((r for r in order
+                       if r.endswith("runtime/constants.py")), None)
+    config_rel = next((r for r in order
+                       if r.endswith("runtime/config.py")), None)
+    if consts_rel is not None and config_rel is not None:
+        constants = {
+            n: (v, int(line)) for n, (v, line)
+            in facts_by_rel[consts_rel].get("consts", {}).items()}
+        used: Set[str] = set()
+        for rel in order:
+            if rel != consts_rel:
+                used.update(facts_by_rel[rel]["const_ids"])  # type: ignore
+        raw = [(str(v), int(ln), int(col)) for v, ln, col
+               in facts_by_rel[config_rel].get("raw_keys", [])]
+        findings += rules_config.assemble(consts_rel, constants, used,
+                                          config_rel, raw)
+    # DRIFT — code facts vs docs/ and run_tests.sh
+    findings += rules_drift.assemble(
+        root,
+        {r: facts_by_rel[r]["metrics"] for r in order
+         if facts_by_rel[r]["metrics"]},       # type: ignore[index]
+        {r: facts_by_rel[r]["sites"] for r in order
+         if facts_by_rel[r]["sites"]},         # type: ignore[index]
+        {r: facts_by_rel[r]["config_classes"] for r in order
+         if facts_by_rel[r]["config_classes"]})  # type: ignore[index]
+    return findings
+
+
+def _suppressed_by_facts(sup: Dict[str, Dict[str, object]],
+                         finding: Finding) -> bool:
+    """Facts-side mirror of ``SourceModule.suppressed`` for assembled
+    findings that land in modules we did not re-parse this run."""
+    for ln in (finding.line, finding.line - 1):
+        entry = sup.get(str(ln))
+        if not entry:
+            continue
+        rules = entry.get("rules", [])
+        if "*" in rules or finding.rule in rules:
+            if ln == finding.line or entry.get("comment_only"):
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# markers (TEST001) — cached per test file by content hash
+# ---------------------------------------------------------------------------
+def _marker_findings(root: str, tests_dir: Optional[str],
+                     pytest_ini: Optional[str],
+                     cache: Dict[str, object]) -> List[Finding]:
+    from . import rules_config
+    tests_dir = tests_dir or os.path.join(root, "tests")
+    pytest_ini = pytest_ini or os.path.join(root, "pytest.ini")
+    if not os.path.isdir(tests_dir) or not os.path.isfile(pytest_ini):
+        return []
+    known = rules_config.registered_markers(pytest_ini) | \
+        rules_config._BUILTIN_MARKERS
+    old = cache.get("markers", {})
+    new: Dict[str, Dict[str, object]] = {}
+    uses_by_rel: Dict[str, List[Tuple[str, int, int]]] = {}
+    for path in rules_config.test_files(tests_dir):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, "r", encoding="utf-8") as f:
+            sha = _sha(f.read())
+        entry = old.get(rel) if isinstance(old, dict) else None
+        if isinstance(entry, dict) and entry.get("sha") == sha:
+            uses = [(str(n), int(ln), int(c))
+                    for n, ln, c in entry["uses"]]  # type: ignore
+        else:
+            uses = rules_config._markers_in_file(path)
+        new[rel] = {"sha": sha,
+                    "uses": [[n, ln, c] for n, ln, c in uses]}
+        uses_by_rel[rel] = uses
+    cache["markers"] = new
+    return rules_config.assemble_marker_findings(uses_by_rel, known)
+
+
+# ---------------------------------------------------------------------------
+# --changed support
+# ---------------------------------------------------------------------------
+def changed_paths(root: str) -> Optional[Set[str]]:
+    """Repo-relative paths changed vs HEAD plus untracked files; None
+    when git is unavailable (callers fall back to a full report)."""
+    out: Set[str] = set()
+    for args in (["git", "diff", "--name-only", "HEAD"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(args, cwd=root, capture_output=True,
+                                  text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# engine entry
+# ---------------------------------------------------------------------------
+@dataclass
+class EngineStats:
+    total_modules: int = 0
+    reanalyzed: int = 0
+    cache_loaded: bool = False
+
+    @property
+    def cached(self) -> int:
+        return self.total_modules - self.reanalyzed
+
+
+def _load_cache(path: str, version: str) -> Dict[str, object]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(data, dict) or data.get("engine") != version:
+        return {}
+    return data
+
+
+def _store_cache(path: str, data: Dict[str, object]) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f, separators=(",", ":"))
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+
+
+def lint_paths_cached(paths: Sequence[str], root: Optional[str] = None,
+                      rules: Optional[Iterable[str]] = None,
+                      check_markers: bool = False,
+                      tests_dir: Optional[str] = None,
+                      pytest_ini: Optional[str] = None,
+                      errors: Optional[List[str]] = None,
+                      min_severity: Optional[str] = None,
+                      cache_file: Optional[str] = None,
+                      no_cache: bool = False,
+                      stats: Optional[EngineStats] = None
+                      ) -> List[Finding]:
+    """Drop-in for ``core.lint_paths`` backed by the incremental cache.
+    Identical findings (the tests pin engine == lint_paths and
+    cold == warm); only the work per run differs."""
+    root = os.path.abspath(root or os.getcwd())
+    cache_path = cache_file or os.path.join(root, CACHE_BASENAME)
+    version = engine_version()
+    cache = {} if no_cache else _load_cache(cache_path, version)
+    if stats is not None:
+        stats.cache_loaded = bool(cache)
+    old_modules = cache.get("modules", {})
+    if not isinstance(old_modules, dict):
+        old_modules = {}
+
+    # -- pass 1: hash every file; parse only the sha-dirty ones --------
+    order: List[str] = []
+    texts: Dict[str, str] = {}
+    dirty: Dict[str, SourceModule] = {}
+    for path in collect_py_files(paths):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            if errors is not None:
+                errors.append(f"{path}: {e}")
+            continue
+        sha = _sha(text)
+        order.append(rel)
+        texts[rel] = sha
+        entry = old_modules.get(rel)
+        if isinstance(entry, dict) and entry.get("sha") == sha and \
+                "facts" in entry and "findings" in entry:
+            continue
+        try:
+            dirty[rel] = SourceModule.parse(path, root)
+        except SyntaxError as e:
+            if errors is not None:
+                errors.append(f"{path}: {e}")
+            order.pop()
+            del texts[rel]
+
+    # -- pass 2: facts (cached or freshly extracted) -------------------
+    facts_by_rel: Dict[str, Dict[str, object]] = {}
+    minis: Dict[str, Project] = {}
+    for rel in order:
+        if rel in dirty:
+            mini = Project(root=root, modules=[dirty[rel]])
+            minis[rel] = mini
+            facts = extract_facts(dirty[rel], get_symtab(mini))
+        else:
+            facts = old_modules[rel]["facts"]  # type: ignore[index]
+        facts_by_rel[rel] = facts
+
+    # -- pass 3: context + fingerprints decide who re-analyzes ---------
+    ctx = build_context(order, facts_by_rel)
+    findings: List[Finding] = []
+    new_modules: Dict[str, object] = {}
+    reanalyzed = 0
+    for rel in order:
+        fp = fingerprint(rel, facts_by_rel[rel], ctx)
+        entry = old_modules.get(rel)
+        if rel not in dirty and isinstance(entry, dict) and \
+                entry.get("fp") == fp:
+            mod_findings = [Finding(**f) for f in entry["findings"]]
+        else:
+            reanalyzed += 1
+            mod = dirty.get(rel)
+            if mod is None:  # fingerprint moved but text did not
+                mod = SourceModule.parse(os.path.join(root, rel), root)
+            mod_findings = analyze_module(mod, ctx, root,
+                                          mini=minis.get(rel))
+        findings += mod_findings
+        new_modules[rel] = {
+            "sha": texts[rel], "fp": fp, "facts": facts_by_rel[rel],
+            "findings": [f.__dict__ for f in mod_findings]}
+    if stats is not None:
+        stats.total_modules = len(order)
+        stats.reanalyzed = reanalyzed
+
+    # -- pass 4: assembly families + markers ---------------------------
+    assembled = _assemble_global(order, facts_by_rel, root)
+    if check_markers:
+        assembled += _marker_findings(root, tests_dir, pytest_ini, cache)
+    for f in assembled:
+        facts = facts_by_rel.get(f.path)
+        if facts is not None and _suppressed_by_facts(
+                facts.get("suppress", {}), f):  # type: ignore[arg-type]
+            continue
+        findings.append(f)
+
+    # -- filters + stable order (mirrors core.lint_paths exactly) ------
+    if rules:
+        pref = tuple(rules)
+        findings = [f for f in findings if f.rule.startswith(pref)]
+    if min_severity:
+        tiers = {Severity.INFO: 0, Severity.WARNING: 1, Severity.ERROR: 2}
+        floor = tiers[min_severity]
+        findings = [f for f in findings if tiers[f.severity] >= floor]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    if not no_cache:
+        cache["engine"] = version
+        cache["modules"] = new_modules
+        _store_cache(cache_path, cache)
+    return findings

@@ -1,0 +1,119 @@
+"""--fix autofixes for mechanically-safe findings.
+
+Only two finding shapes have a fix that is correct by construction:
+
+  DET002   wrap the offending set expression in ``sorted(...)`` — the
+           sink wanted *an* order, sorted gives it a deterministic one
+           and every order-sensitive consumer accepts a list
+  DRIFT001 append a stub row for the unregistered-in-docs metric under
+           the ``<!-- dstpu-lint: metrics-table -->`` marker so the
+           docs table stays structurally valid and a human fills in
+           the description
+
+Everything else (DET001 seed plumbing, FLEET transitions, stale docs
+rows) needs judgment and stays a finding.  Fix targets are re-derived
+from a fresh parse via the same ``iter_det002`` generator ``run`` uses,
+so the rewrite span always matches what was flagged — we never trust
+(line, col) from a findings list against a file that may have shifted.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Tuple
+
+from .core import Finding, SourceModule, annotate_parents
+from .rules_det import iter_det002
+from .rules_drift import METRICS_TABLE_MARK, _doc_files
+
+
+def _span(node: ast.AST) -> Tuple[int, int, int, int]:
+    return (node.lineno, node.col_offset,
+            node.end_lineno, node.end_col_offset)
+
+
+def _wrap_sorted(lines: List[str], span: Tuple[int, int, int, int]
+                 ) -> None:
+    """Insert ``sorted(`` / ``)`` around a 0-based-line span in place.
+    Spans are applied end-of-file-first so earlier offsets stay valid."""
+    l0, c0, l1, c1 = span
+    lines[l1 - 1] = lines[l1 - 1][:c1] + ")" + lines[l1 - 1][c1:]
+    lines[l0 - 1] = (lines[l0 - 1][:c0] + "sorted(" +
+                     lines[l0 - 1][c0:])
+
+
+def fix_det002(root: str, findings: List[Finding]) -> Dict[str, int]:
+    """Wrap every DET002 set expression in ``sorted(...)``; returns
+    rel -> number of rewrites."""
+    out: Dict[str, int] = {}
+    for rel in sorted({f.path for f in findings if f.rule == "DET002"}):
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        mod = SourceModule.parse(path, root)
+        annotate_parents(mod.tree)
+        flagged = {(f.line, f.col) for f in findings
+                   if f.rule == "DET002" and f.path == rel}
+        spans = [_span(set_expr)
+                 for _kind, node, set_expr in iter_det002(mod)
+                 if (node.lineno, node.col_offset) in flagged]
+        if not spans:
+            continue
+        lines = mod.text.splitlines(keepends=False)
+        trailing_nl = mod.text.endswith("\n")
+        for span in sorted(spans, reverse=True):
+            _wrap_sorted(lines, span)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write("\n".join(lines) + ("\n" if trailing_nl else ""))
+        out[rel] = len(spans)
+    return out
+
+
+def fix_drift001(root: str, findings: List[Finding]) -> Dict[str, int]:
+    """Append a stub docs-table row per DRIFT001 metric under the
+    metrics-table marker; returns docs rel -> rows added.  Without a
+    marked table the fixer declines (it will not guess which of the
+    docs tables a metric belongs in)."""
+    names = sorted({f.detail for f in findings if f.rule == "DRIFT001"})
+    if not names:
+        return {}
+    target = None
+    for path in _doc_files(root):
+        with open(path, "r", encoding="utf-8") as fh:
+            lines = fh.read().splitlines(keepends=False)
+        for i, line in enumerate(lines):
+            if METRICS_TABLE_MARK in line:
+                target = (path, i, lines)
+                break
+        if target:
+            break
+    if target is None:
+        return {}
+    path, mark_idx, lines = target
+    # insert directly under the last table row following the marker so
+    # stubs extend the marked table instead of orphaning below prose
+    insert_at = mark_idx + 1
+    for j in range(mark_idx + 1, len(lines)):
+        if lines[j].strip().startswith("|"):
+            insert_at = j + 1
+        elif lines[j].strip() and insert_at > mark_idx + 1:
+            break
+    stubs = [f"| `{n}` | _TODO: kind_ | _TODO: describe ({n})_ |"
+             for n in names]
+    lines[insert_at:insert_at] = stubs
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(lines) + "\n")
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    return {rel: len(stubs)}
+
+
+def apply_fixes(root: str, findings: List[Finding]) -> Dict[str, int]:
+    """All autofixes; returns path -> edit count (empty = nothing to
+    do).  Callers re-lint afterwards — fixes change content hashes, so
+    the incremental engine re-analyzes exactly the touched modules."""
+    out: Dict[str, int] = {}
+    for batch in (fix_det002(root, findings),
+                  fix_drift001(root, findings)):
+        for rel, n in batch.items():
+            out[rel] = out.get(rel, 0) + n
+    return out

@@ -273,6 +273,52 @@ class _Collector(ast.NodeVisitor):
 
 
 # ---------------------------------------------------------------------------
+# closure computation — shared by ``analyze`` (live ASTs) and the
+# incremental engine (cached per-module summaries): both paths MUST
+# agree on hotness or warm runs would drift from cold ones
+# ---------------------------------------------------------------------------
+def compute_hot_sets(funcs_data: Dict[FuncKey, Tuple[str, Set[FuncKey],
+                                                     Set[FuncKey], bool]],
+                     wrap_targets) -> Tuple[Set[FuncKey], Set[FuncKey],
+                                            Set[FuncKey]]:
+    """``funcs_data``: key -> (simple name, calls, refs, decorator/lambda
+    jit_root). ``wrap_targets``: keys wrapped by ``jax.jit(...)`` calls.
+    Returns (effective jit roots, jit-hot closure, step-hot closure)."""
+    jit_roots = {k for k, (_n, _c, _r, j) in funcs_data.items() if j}
+    jit_roots |= {t for t in wrap_targets if t in funcs_data}
+
+    def closure(roots: Set[FuncKey]) -> Set[FuncKey]:
+        seen: Set[FuncKey] = set()
+        stack = [r for r in roots if r in funcs_data]
+        while stack:
+            k = stack.pop()
+            if k in seen:
+                continue
+            seen.add(k)
+            data = funcs_data.get(k)
+            if data is None:
+                continue
+            for nxt in data[1] | data[2]:
+                if nxt in funcs_data and nxt not in seen:
+                    stack.append(nxt)
+        return seen
+
+    step_roots = jit_roots | {k for k, (n, _c, _r, _j) in funcs_data.items()
+                              if n in STEP_ROOT_NAMES}
+    return jit_roots, closure(jit_roots), closure(step_roots)
+
+
+def collect_module(mod: SourceModule, idx: ModuleIndex
+                   ) -> Tuple[Dict[FuncKey, FuncInfo], List[JitWrap]]:
+    """Run the per-module collector in isolation (the incremental
+    engine's entry: one dirty module, hotness injected from context)."""
+    funcs: Dict[FuncKey, FuncInfo] = {}
+    wraps: List[JitWrap] = []
+    _Collector(mod, idx, funcs, wraps).visit(mod.tree)
+    return funcs, wraps
+
+
+# ---------------------------------------------------------------------------
 # public entry
 # ---------------------------------------------------------------------------
 def get_hot(project: Project) -> HotInfo:
@@ -290,30 +336,14 @@ def analyze(project: Project) -> HotInfo:
     wraps: List[JitWrap] = []
     for mod in project.modules:
         _Collector(mod, symtab.index(mod), funcs, wraps).visit(mod.tree)
+    funcs_data = {k: (f.name, f.calls, f.refs, f.jit_root)
+                  for k, f in funcs.items()}
+    jit_roots, jit_hot, step_hot = compute_hot_sets(
+        funcs_data, [w.target for w in wraps if w.target is not None])
     # lambdas registered during the walk may be jit targets recorded
-    # before resolution; mark any wrap target that exists now
-    for w in wraps:
-        if w.target is not None and w.target in funcs:
-            funcs[w.target].jit_root = True
-
-    def closure(roots: Set[FuncKey]) -> Set[FuncKey]:
-        seen = set()
-        stack = [r for r in roots if r in funcs]
-        while stack:
-            k = stack.pop()
-            if k in seen:
-                continue
-            seen.add(k)
-            info = funcs.get(k)
-            if info is None:
-                continue
-            for nxt in info.calls | info.refs:
-                if nxt in funcs and nxt not in seen:
-                    stack.append(nxt)
-        return seen
-
-    jit_roots = {k for k, f in funcs.items() if f.jit_root}
-    step_roots = jit_roots | {k for k, f in funcs.items()
-                              if f.name in STEP_ROOT_NAMES}
-    return HotInfo(funcs=funcs, jit_hot=closure(jit_roots),
-                   step_hot=closure(step_roots), jit_wraps=wraps)
+    # before resolution; the shared closure marks them — reflect the
+    # effective root set back onto the infos rules consume
+    for k in jit_roots:
+        funcs[k].jit_root = True
+    return HotInfo(funcs=funcs, jit_hot=jit_hot,
+                   step_hot=step_hot, jit_wraps=wraps)

@@ -81,14 +81,15 @@ def _raw_key_calls(tree: ast.Module) -> List[Tuple[str, ast.AST]]:
     return out
 
 
-def run(project: Project) -> List[Finding]:
-    consts_mod = project.by_rel("runtime/constants.py")
-    config_mod = project.by_rel("runtime/config.py")
-    if consts_mod is None or config_mod is None:
-        return []
+def assemble(consts_rel: str,
+             constants: Dict[str, Tuple[object, int]],
+             used: Set[str],
+             config_rel: Optional[str],
+             raw_keys: List[Tuple[str, int, int]]) -> List[Finding]:
+    """Pure CFG assembly from extracted facts — ``run`` feeds it from a
+    live project, the incremental engine from its per-module cache (the
+    family is inherently global: a constant's consumers live anywhere)."""
     findings: List[Finding] = []
-    constants = _collect_constants(consts_mod.tree)
-    used = _identifier_usage(project, consts_mod.rel)
     key_values: Set[str] = set()
     for name, (value, line) in sorted(constants.items()):
         is_default = name.endswith("_DEFAULT")
@@ -99,29 +100,42 @@ def run(project: Project) -> List[Finding]:
         if is_default:
             findings.append(Finding(
                 rule="CFG002", severity=Severity.WARNING,
-                path=consts_mod.rel, line=line, col=0,
+                path=consts_rel, line=line, col=0,
                 message=f"default constant {name} is consumed nowhere — "
                         f"the schema default it encodes is dead",
                 detail=name))
         elif isinstance(value, str):
             findings.append(Finding(
                 rule="CFG001", severity=Severity.WARNING,
-                path=consts_mod.rel, line=line, col=0,
+                path=consts_rel, line=line, col=0,
                 message=f"config key constant {name} "
                         f"({value!r}) is consumed nowhere — users who "
                         f"set this key get a silent no-op",
                 detail=name))
-    for value, node in _raw_key_calls(config_mod.tree):
+    for value, line, col in raw_keys:
         if value in key_values:
             continue
         findings.append(Finding(
             rule="CFG003", severity=Severity.WARNING,
-            path=config_mod.rel, line=node.lineno, col=node.col_offset,
+            path=config_rel or "", line=line, col=col,
             message=f"raw config key {value!r} in the parser has no "
                     f"constant in runtime/constants.py — declare it so "
                     f"the schema stays in one place",
             detail=value))
     return findings
+
+
+def run(project: Project) -> List[Finding]:
+    consts_mod = project.by_rel("runtime/constants.py")
+    config_mod = project.by_rel("runtime/config.py")
+    if consts_mod is None or config_mod is None:
+        return []
+    constants = _collect_constants(consts_mod.tree)
+    used = _identifier_usage(project, consts_mod.rel)
+    raw_keys = [(value, node.lineno, node.col_offset)
+                for value, node in _raw_key_calls(config_mod.tree)]
+    return assemble(consts_mod.rel, constants, used, config_mod.rel,
+                    raw_keys)
 
 
 # ---------------------------------------------------------------------------
@@ -159,6 +173,37 @@ def registered_markers(pytest_ini: str) -> Set[str]:
     return out
 
 
+def test_files(tests_dir: str) -> List[str]:
+    """Sorted .py files under ``tests_dir`` (the marker-scan inputs)."""
+    out: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(tests_dir):
+        dirnames[:] = sorted(d for d in dirnames
+                             if not d.startswith((".", "__")))
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def assemble_marker_findings(
+        uses_by_rel: Dict[str, List[Tuple[str, int, int]]],
+        known: Set[str]) -> List[Finding]:
+    """TEST001 assembly from (rel -> marker uses) facts — fed live by
+    ``check_pytest_markers`` and from the engine's per-file cache."""
+    findings: List[Finding] = []
+    for rel in sorted(uses_by_rel):
+        for name, lineno, col in uses_by_rel[rel]:
+            if name not in known:
+                findings.append(Finding(
+                    rule="TEST001", severity=Severity.ERROR,
+                    path=rel, line=lineno, col=col,
+                    message=f"pytest marker `{name}` is not "
+                            f"registered in pytest.ini — "
+                            f"`-m {name}` silently selects nothing",
+                    detail=name))
+    return findings
+
+
 def check_pytest_markers(root: str, tests_dir: Optional[str] = None,
                          pytest_ini: Optional[str] = None
                          ) -> List[Finding]:
@@ -167,22 +212,8 @@ def check_pytest_markers(root: str, tests_dir: Optional[str] = None,
     if not os.path.isdir(tests_dir) or not os.path.isfile(pytest_ini):
         return []
     known = registered_markers(pytest_ini) | _BUILTIN_MARKERS
-    findings: List[Finding] = []
-    for dirpath, dirnames, filenames in os.walk(tests_dir):
-        dirnames[:] = sorted(d for d in dirnames
-                             if not d.startswith((".", "__")))
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, root).replace(os.sep, "/")
-            for name, lineno, col in _markers_in_file(path):
-                if name not in known:
-                    findings.append(Finding(
-                        rule="TEST001", severity=Severity.ERROR,
-                        path=rel, line=lineno, col=col,
-                        message=f"pytest marker `{name}` is not "
-                                f"registered in pytest.ini — "
-                                f"`-m {name}` silently selects nothing",
-                        detail=name))
-    return findings
+    uses_by_rel: Dict[str, List[Tuple[str, int, int]]] = {}
+    for path in test_files(tests_dir):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        uses_by_rel[rel] = _markers_in_file(path)
+    return assemble_marker_findings(uses_by_rel, known)

@@ -1,0 +1,336 @@
+"""DET — determinism hazards on token-exact serving paths.
+
+The serving contract (docs/serving.md, PRs 12/15/16) is that streams are
+token-exact and replayable across batch order, preemption, mesh shape,
+failover and prefill/decode handoff.  That contract rests on coding
+conventions no runtime assertion can see: PRNG keys must flow through
+the pinned ``fold_in(request_key, j)`` schedule, anything feeding a
+content digest / placement score / admission order must iterate in a
+defined order, and policy code must read its injectable clock rather
+than the wall.  These rules make the conventions machine-checked:
+
+  DET001  ad-hoc randomness in serving/fleet code: ``random.*`` /
+          ``np.random.*`` anywhere under ``inference/serving/``, or a
+          ``jax.random.PRNGKey(x)`` whose seed is neither a literal nor
+          derived from a function parameter — fresh keys outside the
+          blessed per-request fold_in schedule break replayability
+  DET002  iteration over a ``set`` feeding an order-sensitive sink
+          (list/tuple materialization, ``join``, ordered accumulation,
+          digest update) — set order varies with PYTHONHASHSEED, so
+          placement scores and content hashes built from it drift
+          between processes (``--fix`` wraps the set in ``sorted()``)
+  DET003  wall-clock read (``time.time``/``datetime.now``) inside a
+          function that already takes an injectable clock parameter —
+          the decision becomes untestable and replays diverge
+  DET004  ``for ... in d.values()/d.items()`` whose body mutates ``d``
+          — besides the RuntimeError risk, the surviving iteration
+          order depends on interleaving; snapshot with ``list(...)``
+
+DET001 is scoped to ``inference/serving/`` (the token-exact surface);
+the other rules apply package-wide — a nondeterministic digest is a bug
+wherever it lives.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from .core import (Finding, Project, Severity, SourceModule,
+                   callee_name as _callee_name, enclosing_function,
+                   enclosing_scope, get_symtab, src_of as _src)
+
+#: DET001 applies to modules whose repo-relative path contains this
+SERVING_SCOPE = "inference/serving/"
+
+#: wall-clock reads DET003 flags when an injectable clock is in scope
+_WALLCLOCK = {"time.time", "time.monotonic", "datetime.now",
+              "datetime.datetime.now", "datetime.utcnow",
+              "datetime.datetime.utcnow"}
+
+#: parameter names that mark a function as taking an injectable clock
+_CLOCK_PARAMS = {"clock", "clock_fn", "now", "now_fn", "now_s",
+                 "time_fn", "timer"}
+
+#: consumers whose result does not depend on iteration order — a set
+#: flowing straight into one of these is fine (sum is NOT here: float
+#: accumulation order changes the result, and scores are floats)
+_ORDER_FREE_CONSUMERS = {"set", "frozenset", "sorted", "len", "max",
+                         "min", "any", "all", "sum"}
+
+#: digest-ish receivers whose .update() makes a loop order-sensitive
+_DIGEST_HINTS = ("hash", "digest", "sha", "crc", "md5", "blake")
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+# ---------------------------------------------------------------------------
+# DET001 — ad-hoc randomness on the serving surface
+# ---------------------------------------------------------------------------
+def _func_params(node: ast.AST) -> Set[str]:
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return set()
+    a = node.args
+    return {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)
+            if p.arg not in ("self", "cls")}
+
+
+def _prngkey_blessed(call: ast.Call) -> bool:
+    """``PRNGKey(x)`` is blessed when the seed is a literal (a pinned
+    base key) or derived only from the enclosing function's parameters
+    (a caller-provided seed — e.g. ``submit(seed=...)``): both are
+    replayable.  Anything else mints a fresh unpinned key stream."""
+    if not call.args or call.keywords:
+        return False
+    seed = call.args[0]
+    if isinstance(seed, ast.Constant):
+        return True
+    fn = enclosing_function(call)
+    if fn is None:
+        return False
+    params = _func_params(fn)
+    names = [n.id for n in ast.walk(seed) if isinstance(n, ast.Name)]
+    return bool(names) and all(n in params or n == "self" for n in names)
+
+
+def _check_randomness(mod: SourceModule, symtab,
+                      findings: List[Finding]) -> None:
+    for call in symtab.calls[mod.rel]:
+        dotted = _dotted(call.func)
+        if not dotted:
+            continue
+        if dotted.startswith(("random.", "np.random.", "numpy.random.")):
+            findings.append(Finding(
+                rule="DET001", severity=Severity.ERROR, path=mod.rel,
+                line=call.lineno, col=call.col_offset,
+                message=f"`{_src(call)}` in serving code draws from "
+                        f"global PRNG state — token-exact replay "
+                        f"requires jax.random keys folded through the "
+                        f"per-request fold_in schedule",
+                scope=enclosing_scope(call), detail=dotted))
+            continue
+        if (dotted == "PRNGKey" or dotted.endswith(".PRNGKey")) and \
+                not _prngkey_blessed(call):
+            findings.append(Finding(
+                rule="DET001", severity=Severity.ERROR, path=mod.rel,
+                line=call.lineno, col=call.col_offset,
+                message=f"`{_src(call)}` mints a PRNG key from a "
+                        f"non-literal, non-parameter seed — serving "
+                        f"keys must be pinned at submit time and "
+                        f"folded per step (fold_in(request_key, j)) "
+                        f"or replay diverges",
+                scope=enclosing_scope(call), detail=f"PRNGKey:{_src(call, 24)}"))
+
+
+# ---------------------------------------------------------------------------
+# DET002 — set iteration feeding an order-sensitive sink
+# ---------------------------------------------------------------------------
+def _set_assigned_names(scope_node: ast.AST) -> Set[str]:
+    """Names assigned from an obvious set expression within the scope
+    (one pass + one propagation round is enough for lint purposes)."""
+    names: Set[str] = set()
+    for _ in range(2):
+        for node in ast.walk(scope_node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and _is_set_expr(node.value, names):
+                names.add(node.targets[0].id)
+    return names
+
+
+def _is_set_expr(node: ast.AST, set_names: Set[str]) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and \
+            _callee_name(node) in ("set", "frozenset"):
+        return True
+    if isinstance(node, ast.Name) and node.id in set_names:
+        return True
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitOr, ast.BitAnd, ast.BitXor, ast.Sub)):
+        return _is_set_expr(node.left, set_names) or \
+            _is_set_expr(node.right, set_names)
+    return False
+
+
+def _loop_body_order_sensitive(loop: ast.For) -> bool:
+    for node in ast.walk(loop):
+        if node is loop:
+            continue
+        if isinstance(node, (ast.Yield, ast.YieldFrom, ast.AugAssign)):
+            return True
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr in ("append", "extend"):
+                return True
+            if attr == "update":
+                recv = _src(node.func.value, 48).lower()
+                if any(h in recv for h in _DIGEST_HINTS):
+                    return True
+    return False
+
+
+def iter_det002(mod: SourceModule
+                ) -> Iterator[Tuple[str, ast.AST, ast.AST]]:
+    """Yield (sink-kind, node-to-flag, set-expr-to-sort) triples.  The
+    third element is what ``--fix`` wraps in ``sorted(...)``; shared by
+    ``run`` and the fixer so both always agree on the span."""
+    set_names = _set_assigned_names(mod.tree)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            name = _callee_name(node)
+            if name in ("list", "tuple", "enumerate") and \
+                    len(node.args) == 1 and not node.keywords and \
+                    _is_set_expr(node.args[0], set_names):
+                yield (f"{name}()", node, node.args[0])
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "join" and len(node.args) == 1 and \
+                    _is_set_expr(node.args[0], set_names):
+                yield ("join", node, node.args[0])
+        elif isinstance(node, ast.For) and \
+                _is_set_expr(node.iter, set_names) and \
+                _loop_body_order_sensitive(node):
+            yield ("for", node, node.iter)
+        elif isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+            gen = node.generators[0]
+            if not _is_set_expr(gen.iter, set_names):
+                continue
+            parent = getattr(node, "_dstpu_parent", None)
+            if isinstance(parent, ast.Call) and \
+                    _callee_name(parent) in _ORDER_FREE_CONSUMERS:
+                continue
+            yield ("comprehension", node, gen.iter)
+
+
+def _check_set_order(mod: SourceModule, findings: List[Finding]) -> None:
+    for kind, node, set_expr in iter_det002(mod):
+        findings.append(Finding(
+            rule="DET002", severity=Severity.WARNING, path=mod.rel,
+            line=node.lineno, col=node.col_offset,
+            message=f"set iterated into an order-sensitive {kind} sink "
+                    f"(`{_src(set_expr, 40)}`) — set order varies with "
+                    f"PYTHONHASHSEED, so digests/scores/orderings "
+                    f"built from it differ across processes; wrap in "
+                    f"sorted(...)",
+            scope=enclosing_scope(node),
+            detail=f"{kind}:{_src(set_expr, 32)}"))
+
+
+# ---------------------------------------------------------------------------
+# DET003 — wall clock read beside an injectable clock
+# ---------------------------------------------------------------------------
+def _enclosing_stmt(node: ast.AST) -> Optional[ast.stmt]:
+    cur: Optional[ast.AST] = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = getattr(cur, "_dstpu_parent", None)
+    return cur
+
+
+def _check_wall_clock(mod: SourceModule, symtab,
+                      findings: List[Finding]) -> None:
+    for call in symtab.calls[mod.rel]:
+        dotted = _dotted(call.func)
+        if dotted not in _WALLCLOCK:
+            continue
+        fn = enclosing_function(call)
+        if fn is None:
+            continue
+        clock_params = _func_params(fn) & _CLOCK_PARAMS
+        if not clock_params:
+            continue
+        # the ``now if now is not None else time.time()`` default idiom
+        # IS the injection point — a statement that references the
+        # clock parameter is the fallback, not a bypass
+        stmt = _enclosing_stmt(call)
+        if stmt is not None and any(
+                isinstance(n, ast.Name) and n.id in clock_params
+                for n in ast.walk(stmt)):
+            continue
+        findings.append(Finding(
+            rule="DET003", severity=Severity.WARNING, path=mod.rel,
+            line=call.lineno, col=call.col_offset,
+            message=f"`{_src(call)}` reads the wall clock although "
+                    f"`{sorted(clock_params)[0]}` is injectable here — "
+                    f"policy decisions must use the injected clock or "
+                    f"replays and tests diverge from production",
+            scope=enclosing_scope(call),
+            detail=f"{dotted}:{sorted(clock_params)[0]}"))
+
+
+# ---------------------------------------------------------------------------
+# DET004 — mutation of a dict while iterating its views
+# ---------------------------------------------------------------------------
+def _mutates_receiver(loop: ast.For, recv: str) -> Optional[ast.AST]:
+    for node in ast.walk(loop):
+        if node is loop:
+            continue
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("pop", "popitem", "clear",
+                                   "setdefault", "update") and \
+                _src(node.func.value, 80) == recv:
+            return node
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and \
+                        _src(t.value, 80) == recv:
+                    return node
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and \
+                        _src(t.value, 80) == recv:
+                    return node
+    return None
+
+
+def _check_view_mutation(mod: SourceModule,
+                         findings: List[Finding]) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.For):
+            continue
+        it = node.iter
+        if not (isinstance(it, ast.Call) and
+                isinstance(it.func, ast.Attribute) and
+                it.func.attr in ("values", "items") and not it.args):
+            continue
+        recv = _src(it.func.value, 80)
+        hit = _mutates_receiver(node, recv)
+        if hit is None:
+            continue
+        findings.append(Finding(
+            rule="DET004", severity=Severity.ERROR, path=mod.rel,
+            line=node.lineno, col=node.col_offset,
+            message=f"loop over `{recv}.{it.func.attr}()` mutates "
+                    f"`{recv}` at line {hit.lineno} — the surviving "
+                    f"iteration order depends on interleaving (and "
+                    f"CPython raises mid-flight); snapshot with "
+                    f"list({recv}.{it.func.attr}())",
+            scope=enclosing_scope(node),
+            detail=f"{recv}.{it.func.attr}"))
+
+
+def run(project: Project) -> List[Finding]:
+    symtab = get_symtab(project)
+    findings: List[Finding] = []
+    for mod in project.modules:
+        run_module(mod, symtab, findings)
+    return findings
+
+
+def run_module(mod: SourceModule, symtab,
+               findings: List[Finding]) -> None:
+    """Per-module entry — DET is fully module-local, so the incremental
+    engine re-runs exactly the dirty modules through this."""
+    if SERVING_SCOPE in mod.rel:
+        _check_randomness(mod, symtab, findings)
+    _check_set_order(mod, findings)
+    _check_wall_clock(mod, symtab, findings)
+    _check_view_mutation(mod, findings)

@@ -1,0 +1,185 @@
+"""FLEET — replica-lifecycle state-machine discipline.
+
+PR 15's fleet owns a five-state replica lifecycle (STARTING → HEALTHY →
+DRAINING → RETIRED, any → DEAD).  Failover correctness leans on those
+edges: a replica that jumps STARTING → DRAINING never drains its queue,
+and a RETIRED replica resurrected by a stray assignment double-serves
+requests that already failed over.  The lifecycle owner declares its
+legal edges in a ``_TRANSITIONS`` table; these rules check every
+``.state = ReplicaState.X`` assignment against it:
+
+  FLEET001  state assignment whose enclosing function does not guard on
+            a predecessor state that legally reaches the new state
+            (guards are ``.state is/== ReplicaState.G`` comparisons; an
+            unguarded assignment is legal only for the initial state in
+            ``__init__``, and an idempotence re-stamp ``if state is X:
+            return`` is legal when X is reachable at all)
+  FLEET002  terminal state (no outgoing edges in the table) assigned
+            outside the module that declares the table — terminal
+            stamps are the lifecycle owner's single-writer privilege,
+            exactly like LIFE002's ``_terminalize`` rule
+
+The table is declared next to the enum::
+
+    _TRANSITIONS = {
+        ReplicaState.STARTING: (ReplicaState.HEALTHY, ReplicaState.DEAD),
+        ...
+        ReplicaState.DEAD: (),
+    }
+
+When no module declares a table the family stays silent (fixture
+projects, pre-fleet trees) rather than guessing the state machine.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (Finding, Project, Severity, SourceModule,
+                   enclosing_function, enclosing_scope, get_symtab,
+                   src_of as _src)
+
+TABLE_NAME = "_TRANSITIONS"
+STATE_ENUM = "ReplicaState"
+
+#: transition table: state member -> tuple of legal successor members
+Table = Dict[str, Tuple[str, ...]]
+
+
+def _state_member(node: ast.AST) -> Optional[str]:
+    """'HEALTHY' for a ``ReplicaState.HEALTHY`` expression."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id == STATE_ENUM:
+        return node.attr
+    return None
+
+
+def transitions_table(mod: SourceModule) -> Optional[Table]:
+    """Parse a module's declared ``_TRANSITIONS`` dict (module or class
+    scope); None when the module declares none."""
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == TABLE_NAME
+                and isinstance(node.value, ast.Dict)):
+            continue
+        table: Table = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            src = _state_member(k) if k is not None else None
+            if src is None or not isinstance(v, (ast.Tuple, ast.List)):
+                continue
+            succ = tuple(m for m in (_state_member(e) for e in v.elts)
+                         if m is not None)
+            table[src] = succ
+        if table:
+            return table
+    return None
+
+
+def _initial_states(table: Table) -> Set[str]:
+    """Members with no incoming edge — legal for unguarded ``__init__``
+    assignments."""
+    targets: Set[str] = set()
+    for succ in table.values():
+        targets |= set(succ)
+    return {m for m in table if m not in targets}
+
+
+def _guard_states(fn: ast.AST) -> Set[str]:
+    """Members compared against any ``.state`` attribute inside ``fn``
+    (``is`` / ``is not`` / ``==`` / ``!=`` all count: both the positive
+    gate and the raise-unless-predecessor idiom name the predecessor)."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        has_state_attr = any(
+            isinstance(s, ast.Attribute) and s.attr == "state"
+            for s in sides)
+        if not has_state_attr:
+            continue
+        for s in sides:
+            m = _state_member(s)
+            if m is not None:
+                out.add(m)
+    return out
+
+
+def _state_assignments(mod: SourceModule
+                       ) -> List[Tuple[ast.Assign, str]]:
+    out: List[Tuple[ast.Assign, str]] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign) or node.value is None:
+            continue
+        if not any(isinstance(t, ast.Attribute) and t.attr == "state"
+                   for t in node.targets):
+            continue
+        member = _state_member(node.value)
+        if member is not None:
+            out.append((node, member))
+    return out
+
+
+def check_module(mod: SourceModule, table: Table, owner_rel: str,
+                 findings: List[Finding]) -> None:
+    """FLEET001/002 for one module against the declared table — the
+    per-module entry the incremental engine calls with cached context."""
+    initial = _initial_states(table)
+    reachable = {m for succ in table.values() for m in succ} | initial
+    terminal = {m for m, succ in table.items() if not succ}
+    for node, member in _state_assignments(mod):
+        if member in terminal and mod.rel != owner_rel:
+            findings.append(Finding(
+                rule="FLEET002", severity=Severity.ERROR, path=mod.rel,
+                line=node.lineno, col=node.col_offset,
+                message=f"terminal {STATE_ENUM}.{member} stamped "
+                        f"outside the lifecycle owner ({owner_rel}) — "
+                        f"terminal states are the owner's single-writer "
+                        f"privilege (failover replay and autoscaler "
+                        f"accounting key off exactly-once stamps)",
+                scope=enclosing_scope(node), detail=member))
+            continue
+        fn = enclosing_function(node)
+        guards = _guard_states(fn) if fn is not None else set()
+        legal = any(member in table.get(g, ()) for g in guards
+                    if g != member)
+        if not legal and member in guards and member in reachable:
+            legal = True  # idempotence guard: ``if state is X: return``
+        if not legal and not guards and fn is not None and \
+                fn.name == "__init__" and member in initial:
+            legal = True
+        if not legal:
+            findings.append(Finding(
+                rule="FLEET001", severity=Severity.ERROR, path=mod.rel,
+                line=node.lineno, col=node.col_offset,
+                message=f"`{_src(node, 44)}` without a guard on a "
+                        f"predecessor that {TABLE_NAME} allows to reach "
+                        f"{member} — unchecked transitions are how a "
+                        f"replica skips its drain or resurrects after "
+                        f"retirement",
+                scope=enclosing_scope(node),
+                detail=f"{member}:{','.join(sorted(guards)) or 'unguarded'}"))
+
+
+def find_table(project: Project) -> Tuple[Optional[Table], str]:
+    """(table, declaring module rel) — first declaring module wins; the
+    module list is sorted by ``collect_py_files`` so the scan order is
+    deterministic."""
+    for mod in project.modules:
+        table = transitions_table(mod)
+        if table is not None:
+            return table, mod.rel
+    return None, ""
+
+
+def run(project: Project) -> List[Finding]:
+    get_symtab(project)  # parent links for enclosing_* helpers
+    table, owner_rel = find_table(project)
+    if table is None:
+        return []
+    findings: List[Finding] = []
+    for mod in project.modules:
+        check_module(mod, table, owner_rel, findings)
+    return findings

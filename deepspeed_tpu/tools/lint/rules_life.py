@@ -16,13 +16,11 @@ three are mechanically visible in the AST:
            ``_terminalize`` — the single stamp point is what makes
            terminal states exactly-once (cancel/timeout/quarantine all
            funnel through it)
-  LIFE003  ``FaultInjector`` site id used in code but absent from the
-           documented site catalog (``docs/resilience.md``) — an
-           undocumented site is a failure path the chaos matrix never
-           sweeps
-
-LIFE003 reads the catalog as the set of backtick-quoted tokens in
-``docs/resilience.md``; when the doc is absent the rule stays silent.
+LIFE003 (undocumented ``FaultInjector`` sites) lived here through PR 16;
+it is subsumed by DRIFT003 (``rules_drift.py``), which additionally
+requires every site to appear in a ``run_tests.sh`` chaos matrix.  The
+site-extraction helpers (``documented_sites`` / ``_injector_site``)
+stay here and are shared with the DRIFT family.
 """
 from __future__ import annotations
 
@@ -178,7 +176,7 @@ def _check_terminal_stamps(mod: SourceModule, findings: List[Finding]
 
 
 # ---------------------------------------------------------------------------
-# LIFE003 — undocumented FaultInjector sites
+# fault-injection-site extraction — consumed by DRIFT003 (rules_drift)
 # ---------------------------------------------------------------------------
 def documented_sites(root: str) -> Optional[Set[str]]:
     path = os.path.join(root, SITE_DOC)
@@ -208,29 +206,10 @@ def _injector_site(call: ast.Call) -> Optional[ast.Constant]:
     return None
 
 
-def _check_injector_sites(mod: SourceModule, symtab, catalog: Set[str],
-                          findings: List[Finding]) -> None:
-    for call in symtab.calls[mod.rel]:
-        lit = _injector_site(call)
-        if lit is None or lit.value in catalog:
-            continue
-        findings.append(Finding(
-            rule="LIFE003", severity=Severity.WARNING, path=mod.rel,
-            line=lit.lineno, col=lit.col_offset,
-            message=f"fault-injection site {lit.value!r} is not in the "
-                    f"documented site catalog ({SITE_DOC}) — an "
-                    f"undocumented site is a failure path the chaos "
-                    f"matrix never sweeps",
-            scope=enclosing_scope(call), detail=lit.value))
-
-
 def run(project: Project) -> List[Finding]:
     symtab = get_symtab(project)
-    catalog = documented_sites(project.root)
     findings: List[Finding] = []
     for mod in project.modules:
         _check_alloc_pairing(mod, symtab, findings)
         _check_terminal_stamps(mod, findings)
-        if catalog is not None:
-            _check_injector_sites(mod, symtab, catalog, findings)
     return findings

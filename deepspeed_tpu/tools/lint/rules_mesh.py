@@ -193,9 +193,16 @@ def _check_shard_map_compat(mod: SourceModule, symtab,
             detail=f"import:{src}"))
 
 
-def run(project: Project) -> List[Finding]:
+#: sentinel: ``run(project)`` computes the axes itself; the incremental
+#: engine passes the context's set (possibly None) explicitly, because a
+#: single-module project cannot see ``parallel/topology.py``
+_AXES_UNSET = object()
+
+
+def run(project: Project, axes=_AXES_UNSET) -> List[Finding]:
     symtab = get_symtab(project)
-    axes = declared_axes(project)
+    if axes is _AXES_UNSET:
+        axes = declared_axes(project)
     findings: List[Finding] = []
     for mod in project.modules:
         in_compat = mod.rel.endswith(COMPAT_REL)

@@ -96,7 +96,9 @@ def _check_compiler_params(mod: SourceModule, symtab,
                         f"ops/pallas_compat.compiler_params()",
                 scope=_scope_of(node), detail=node.attr))
     idx = symtab.index(mod)
-    for name in _CP_NAMES:
+    # sorted: both hits land at line 1 col 0, so emission order is the
+    # only tiebreak between them (DET002 applied to our own source)
+    for name in sorted(_CP_NAMES):
         tgt = idx.from_imports.get(name)
         if tgt is not None:
             findings.append(Finding(
@@ -218,7 +220,7 @@ def _check_wrapper_pads(mod: SourceModule, symtab,
             fn = enclosing_function(call)
             if fn is not None:
                 wrappers.add(fn)
-    for fn in wrappers:
+    for fn in sorted(wrappers, key=lambda f: (f.lineno, f.col_offset)):
         for node in ast.walk(fn):
             if isinstance(node, ast.Call) and \
                     symtab.dotted(node.func) in ("jnp.pad", "np.pad",

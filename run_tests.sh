@@ -204,6 +204,34 @@ if [[ -z "$FILTER" || "fleet" == *"$FILTER"* || "serving" == *"$FILTER"* ]]; the
   done
 fi
 
+# Train-chaos sweep: the checkpoint publish/manifest commit and the
+# slot-I/O paths (NVMe slot store, infinity .npz slots) replayed across
+# a DSTPU_FAULTS matrix covering every training fault-injection site —
+# dstpu-lint DRIFT003 fails the lint stage if a site in the code has no
+# matrix entry here. Transient plans must be absorbed by the shared
+# retry policy with data byte-exact; the fatal publish plan must leave
+# 'latest' on the previous committed tag (docs/resilience.md).
+if [[ -z "$FILTER" || "train_chaos" == *"$FILTER"* || "resilience" == *"$FILTER"* ]]; then
+  TRAIN_CHAOS_MATRIX=(
+    "checkpoint.publish=fail:1:2"
+    "checkpoint.publish=fatal:1:1"
+    "checkpoint.artifact=fail:1:1"
+    "slot_store.write=fail:1:1;slot_store.read=fail:1:1"
+    "infinity.slot_write=fail:1:2"
+    "infinity.slot_read=fail:1:1"
+  )
+  for faults in "${TRAIN_CHAOS_MATRIX[@]}"; do
+    echo "=== train-chaos sweep (DSTPU_FAULTS='${faults}')"
+    if DSTPU_FAULTS="$faults" JAX_PLATFORMS=cpu python -m pytest \
+         tests/unit/test_train_chaos.py -m chaos -q --tb=short \
+         ${EXTRA_PYTEST_ARGS:-}; then
+      PASSED=$((PASSED + 1))
+    else
+      FAILED+=("train-chaos [DSTPU_FAULTS=${faults}]")
+    fi
+  done
+fi
+
 # Disaggregated-fleet sweep: the `disagg`-marked suite — KV-fabric
 # publish/claim units (crc-guarded corruption drop, fault-before-
 # mutation, publisher-scoped orphan reaping), fabric-credit placement

@@ -564,6 +564,17 @@ def test_repo_true_positive_fixes_stay_fixed(repo_findings):
     assert [f.render() for f in repo_findings if f.family == "LIFE"] == []
 
 
+def test_repo_v3_families_clean(repo_findings):
+    """The v3 rollout census was reconciled in-PR, not baselined: the
+    frontend's active-tenant set is sorted (DET002), every replica
+    state write is legal against _TRANSITIONS (FLEET), the metric /
+    config docs tables match the registry and dataclasses, and every
+    fault site is swept by a chaos matrix (DRIFT)."""
+    assert [f.render() for f in repo_findings if f.family == "DET"] == []
+    assert [f.render() for f in repo_findings if f.family == "FLEET"] == []
+    assert [f.render() for f in repo_findings if f.family == "DRIFT"] == []
+
+
 # ---------------------------------------------------------------------------
 # functional regression for the slot_store fix
 # ---------------------------------------------------------------------------
@@ -981,7 +992,10 @@ def test_life_terminal_status_outside_terminalize(tmp_path):
     assert hits == ["FAILED", "OK"]
 
 
-def test_life_undocumented_injector_site(tmp_path):
+def test_drift_undocumented_injector_site(tmp_path):
+    """DRIFT003 subsumes the old LIFE003 doc-catalog check: a site
+    missing from the resilience.md catalog is flagged (no run_tests.sh
+    in the fixture tree, so the matrix half stays silent)."""
     fs = run_lint(tmp_path, {
         "docs_stub.py": "",
         "m.py": """\
@@ -996,24 +1010,27 @@ def test_life_undocumented_injector_site(tmp_path):
     doc.parent.mkdir(exist_ok=True)
     doc.write_text("Sites: `serving.allocate`, `other.site`.\n")
     fs = lint_paths([str(tmp_path)], root=str(tmp_path))
-    hits = [f for f in fs if f.rule == "LIFE003"]
+    hits = [f for f in fs if f.rule == "DRIFT003"]
     assert len(hits) == 1 and hits[0].detail == "serving.brand_new_site"
+    assert "documented catalog" in hits[0].message
+    assert not any(f.rule == "LIFE003" for f in fs), "LIFE003 is retired"
 
 
-def test_life_no_catalog_doc_stays_silent(tmp_path):
+def test_drift_no_catalog_doc_stays_silent(tmp_path):
     fs = run_lint(tmp_path, {"m.py": """\
         from .resilience import get_fault_injector
 
         def hot_path():
             get_fault_injector().check("serving.allocate")
         """})
-    assert [f for f in fs if f.rule == "LIFE003"] == []
+    assert [f for f in fs if f.rule == "DRIFT003"] == []
 
 
 def test_repo_injector_sites_all_documented(repo_findings):
     """Every live FaultInjector site appears in docs/resilience.md's
-    catalog (LIFE003 green on the real tree)."""
-    assert [f.render() for f in repo_findings if f.rule == "LIFE003"] == []
+    catalog AND in a run_tests.sh chaos matrix (DRIFT003 green on the
+    real tree)."""
+    assert [f.render() for f in repo_findings if f.rule == "DRIFT003"] == []
 
 
 # ---------------------------------------------------------------------------
@@ -1166,3 +1183,512 @@ def test_sync_isfinite_whitelist_is_math_only(tmp_path):
         """})
     s2 = [f.detail for f in fs if f.rule == "SYNC002"]
     assert s2 == ["float:jnp.isfinite(loss)"]
+
+
+# ---------------------------------------------------------------------------
+# DET family — determinism on the token-exact serving surface (v3)
+# ---------------------------------------------------------------------------
+def test_det_adhoc_randomness_scoped_to_serving(tmp_path):
+    """Global-PRNG draws are errors under inference/serving/ and out of
+    scope elsewhere (training code seeds its own streams)."""
+    src = """\
+        import random
+        import numpy as np
+
+        def pick(replicas):
+            return random.choice(replicas)
+
+        def jitter():
+            return np.random.rand()
+        """
+    fs = run_lint(tmp_path, {"inference/serving/router.py": src,
+                             "runtime/warmup.py": src})
+    hits = [f for f in fs if f.rule == "DET001"]
+    assert len(hits) == 2
+    assert {f.path for f in hits} == {"inference/serving/router.py"}
+    assert sorted(f.detail for f in hits) == ["np.random.rand",
+                                              "random.choice"]
+
+
+def test_det_prngkey_seed_provenance(tmp_path):
+    """PRNGKey from a literal or a caller parameter is replayable;
+    anything else mints an unpinned stream."""
+    fs = run_lint(tmp_path, {"inference/serving/sampler.py": """\
+        import jax
+
+        def submit(seed):
+            good = jax.random.PRNGKey(seed)
+            base = jax.random.PRNGKey(1234)
+            bad = jax.random.PRNGKey(id(object()))
+            return good, base, bad
+        """})
+    hits = [f for f in fs if f.rule == "DET001"]
+    assert len(hits) == 1 and hits[0].detail.startswith("PRNGKey:")
+
+
+def test_det_set_into_order_sensitive_sink(tmp_path):
+    fs = run_lint(tmp_path, {"m.py": """\
+        def order(xs):
+            s = {x for x in xs}
+            bad = list(s)                        # DET002: list()
+            ok = sorted(s)
+            n = len({x for x in xs})
+            parts = ",".join({str(x) for x in xs})   # DET002: join
+            out = []
+            for item in s:                       # DET002: ordered loop
+                out.append(item)
+            return bad, ok, n, parts, out
+        """})
+    kinds = sorted(f.detail.split(":")[0] for f in fs
+                   if f.rule == "DET002")
+    assert kinds == ["for", "join", "list()"]
+
+
+def test_det_wallclock_beside_injectable_clock(tmp_path):
+    fs = run_lint(tmp_path, {"m.py": """\
+        import time
+
+        def policy(req, now):
+            t = time.time()          # DET003: dodges the injected clock
+            return t
+
+        def fallback(req, now=None):
+            now = now if now is not None else time.time()   # the idiom
+            return now
+
+        def no_clock(req):
+            return time.time()       # no injectable clock: out of scope
+        """})
+    hits = [f for f in fs if f.rule == "DET003"]
+    assert len(hits) == 1
+    assert hits[0].scope == "policy" and hits[0].detail == "time.time:now"
+
+
+def test_det_dict_view_mutation_in_loop(tmp_path):
+    fs = run_lint(tmp_path, {"m.py": """\
+        def prune(d):
+            for k, v in d.items():
+                if v is None:
+                    d.pop(k)         # DET004: mutates mid-iteration
+
+        def safe(d):
+            for k, v in list(d.items()):
+                if v is None:
+                    d.pop(k)         # snapshot taken first: fine
+        """})
+    hits = [f for f in fs if f.rule == "DET004"]
+    assert len(hits) == 1
+    assert hits[0].scope == "prune" and hits[0].detail == "d.items"
+    assert hits[0].severity == "error"
+
+
+# ---------------------------------------------------------------------------
+# FLEET family — replica-lifecycle state machine (v3)
+# ---------------------------------------------------------------------------
+_FLEET_OWNER = """\
+    import enum
+
+    class ReplicaState(enum.Enum):
+        STARTING = "starting"
+        HEALTHY = "healthy"
+        DRAINING = "draining"
+        RETIRED = "retired"
+        DEAD = "dead"
+
+    _TRANSITIONS = {
+        ReplicaState.STARTING: (ReplicaState.HEALTHY, ReplicaState.DEAD),
+        ReplicaState.HEALTHY: (ReplicaState.DRAINING, ReplicaState.DEAD),
+        ReplicaState.DRAINING: (ReplicaState.RETIRED, ReplicaState.DEAD),
+        ReplicaState.RETIRED: (),
+        ReplicaState.DEAD: (),
+    }
+
+    class Replica:
+        def __init__(self):
+            self.state = ReplicaState.STARTING   # initial: legal
+
+        def mark_healthy(self):
+            if self.state is ReplicaState.STARTING:
+                self.state = ReplicaState.HEALTHY
+
+        def resurrect(self):
+            self.state = ReplicaState.HEALTHY    # FLEET001: unguarded
+    """
+
+
+def test_fleet_transition_validated_against_table(tmp_path):
+    fs = run_lint(tmp_path, {"fleet/replica.py": _FLEET_OWNER})
+    hits = [f for f in fs if f.rule == "FLEET001"]
+    assert len(hits) == 1 and hits[0].scope == "Replica.resurrect"
+    assert hits[0].detail == "HEALTHY:unguarded"
+    assert hits[0].severity == "error"
+
+
+def test_fleet_terminal_stamp_outside_owner(tmp_path):
+    fs = run_lint(tmp_path, {
+        "fleet/replica.py": _FLEET_OWNER,
+        "fleet/router.py": """\
+        from .replica import ReplicaState
+
+        def drain(r):
+            if r.state is ReplicaState.HEALTHY:
+                r.state = ReplicaState.DRAINING   # guarded + non-terminal
+
+        def kill(r):
+            if r.state is ReplicaState.HEALTHY:
+                r.state = ReplicaState.DEAD       # FLEET002: not the owner
+        """})
+    hits = [f for f in fs if f.rule == "FLEET002"]
+    assert len(hits) == 1
+    assert hits[0].path == "fleet/router.py" and hits[0].detail == "DEAD"
+    assert [f for f in fs if f.rule == "FLEET001"
+            and f.path == "fleet/router.py"] == []
+
+
+def test_fleet_no_table_stays_silent(tmp_path):
+    fs = run_lint(tmp_path, {"m.py": """\
+        import enum
+
+        class ReplicaState(enum.Enum):
+            UP = "up"
+
+        def f(r):
+            r.state = ReplicaState.UP
+        """})
+    assert [f for f in fs if f.rule.startswith("FLEET")] == []
+
+
+# ---------------------------------------------------------------------------
+# DRIFT family — code <-> docs <-> CI-script reconciliation (v3)
+# ---------------------------------------------------------------------------
+def test_drift_metrics_vs_docs_both_directions(tmp_path):
+    fs = run_lint(tmp_path, {
+        "obs.py": """\
+        def setup(registry):
+            registry.counter("dstpu_documented_total")
+            registry.gauge("dstpu_undocumented_depth")
+            for name in ("fwd", "backward"):
+                registry.gauge(f"dstpu_phase_{name}_ms")
+        """,
+        "docs/metrics.md": """\
+        | metric | meaning |
+        |---|---|
+        | `dstpu_documented_total` | covered |
+        | `dstpu_phase_<phase>_ms` | templated row matches the f-string |
+        | `dstpu_ghost_total` | registered nowhere |
+        """})
+    d1 = [f for f in fs if f.rule == "DRIFT001"]
+    assert [f.detail for f in d1] == ["dstpu_undocumented_depth"]
+    assert d1[0].path == "obs.py"
+    d2 = [f for f in fs if f.rule == "DRIFT002"]
+    assert [f.detail for f in d2] == ["dstpu_ghost_total"]
+    assert d2[0].path == "docs/metrics.md"
+
+
+def test_drift_partial_project_does_not_accuse_docs(tmp_path):
+    """A project that registers NO metrics cannot prove a docs row has
+    no registrar — DRIFT002 must stay silent (self-lint, --rules runs
+    over one directory)."""
+    fs = run_lint(tmp_path, {
+        "util.py": "def f():\n    return 1\n",
+        "docs/metrics.md": """\
+        | metric | meaning |
+        |---|---|
+        | `dstpu_elsewhere_total` | registered in a module not linted |
+        """})
+    assert [f for f in fs if f.rule.startswith("DRIFT")] == []
+
+
+def test_drift_site_unswept_by_chaos_matrix(tmp_path):
+    """A site in the docs catalog but absent from every run_tests.sh
+    DSTPU_FAULTS matrix is still drift: CI never sweeps it."""
+    fs = run_lint(tmp_path, {
+        "m.py": """\
+        from .resilience import get_fault_injector
+
+        def a():
+            get_fault_injector().check("covered.site")
+
+        def b():
+            get_fault_injector().check("unswept.site")
+        """,
+        "docs/resilience.md":
+            "Sites: `covered.site`, `unswept.site`.\n",
+        "run_tests.sh": """\
+        MATRIX=(
+          "covered.site=fail:1:1"
+        )
+        """})
+    hits = [f for f in fs if f.rule == "DRIFT003"]
+    assert len(hits) == 1 and hits[0].detail == "unswept.site"
+    assert "chaos matrix" in hits[0].message
+    assert "documented catalog" not in hits[0].message
+
+
+def test_drift_config_key_three_way(tmp_path):
+    """DRIFT004 ties dataclass fields, *_DEFAULT constants and docs
+    config-table rows together — including nested blocks reached from
+    the ServingConfig anchor."""
+    fs = run_lint(tmp_path, {
+        "pkg/inference/config.py": """\
+        from dataclasses import dataclass, field
+        from . import constants as C
+
+        @dataclass
+        class SloBlock:
+            objective: float = C.SLO_OBJECTIVE_DEFAULT
+
+        @dataclass
+        class ServingConfig:
+            enabled: bool = C.SERVING_ENABLED_DEFAULT
+            block_size: int = 16
+            slo: SloBlock = field(default_factory=SloBlock)
+        """,
+        "docs/serving.md": """\
+        | key | default | meaning |
+        |---|---|---|
+        | `serving.enabled` | `false` | fully wired: clean |
+        | `serving.slo.objective` | `0.9` | nested anchor walk |
+        | `serving.ghost_key` | `1` | no dataclass consumes this |
+        """})
+    details = sorted(f.detail for f in fs if f.rule == "DRIFT004")
+    assert details == ["no-constant:serving.block_size",
+                       "stale-doc:serving.ghost_key",
+                       "undocumented:serving.block_size"]
+
+
+# ---------------------------------------------------------------------------
+# incremental engine (v3): equivalence, cold==warm, --changed, --fix
+# ---------------------------------------------------------------------------
+ENGINE_TREE = {
+    "inference/serving/router.py": """\
+        import random
+
+        def pick(replicas):
+            return random.choice(replicas)
+        """,
+    "hot.py": """\
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.item()
+        """,
+    "store.py": """\
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def put(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def reset(self):
+                self._items = []
+        """,
+    "clean.py": "def ok():\n    return 1\n",
+}
+
+
+def _render_all(findings):
+    return "\n".join(f.render() for f in findings)
+
+
+def test_engine_matches_lint_paths(tmp_path):
+    """The cached engine is a drop-in for core.lint_paths: identical
+    findings byte-for-byte on a multi-family tree."""
+    from deepspeed_tpu.tools.lint.engine import lint_paths_cached
+    for rel, src in ENGINE_TREE.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    plain = lint_paths([str(tmp_path)], root=str(tmp_path))
+    cached = lint_paths_cached(
+        [str(tmp_path)], root=str(tmp_path),
+        cache_file=str(tmp_path / ".cache.json"))
+    assert _render_all(plain) == _render_all(cached)
+    assert {f.rule for f in plain} >= {"DET001", "SYNC001", "LOCK001"}
+
+
+def test_engine_cold_warm_byte_identical_and_incremental(tmp_path):
+    """A warm run replays cached modules and matches the cold run
+    byte-for-byte; touching ONE module re-analyzes only it (plus
+    dependents); a fresh no-cache run agrees with the warm one."""
+    from deepspeed_tpu.tools.lint.engine import (EngineStats,
+                                                 lint_paths_cached)
+    for rel, src in ENGINE_TREE.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    cache = str(tmp_path / ".cache.json")
+    args = ([str(tmp_path)],)
+    kw = dict(root=str(tmp_path), cache_file=cache)
+
+    cold_stats = EngineStats()
+    cold = lint_paths_cached(*args, stats=cold_stats, **kw)
+    assert cold_stats.reanalyzed == cold_stats.total_modules > 0
+
+    warm_stats = EngineStats()
+    warm = lint_paths_cached(*args, stats=warm_stats, **kw)
+    assert warm_stats.reanalyzed == 0 and warm_stats.cache_loaded
+    assert _render_all(cold).encode() == _render_all(warm).encode()
+
+    # touch one module: a second hazard appears, others replay cached
+    (tmp_path / "store.py").write_text(
+        textwrap.dedent(ENGINE_TREE["store.py"]) + textwrap.dedent("""\
+
+        def reset_again(store):
+            store._items = []
+        """))
+    inc_stats = EngineStats()
+    inc = lint_paths_cached(*args, stats=inc_stats, **kw)
+    assert 1 <= inc_stats.reanalyzed < inc_stats.total_modules
+    fresh = lint_paths_cached(*args, root=str(tmp_path), no_cache=True)
+    assert _render_all(inc).encode() == _render_all(fresh).encode()
+
+
+def test_engine_cache_survives_corruption(tmp_path):
+    """A torn/garbage cache file degrades to a cold run, never a crash
+    or stale findings."""
+    from deepspeed_tpu.tools.lint.engine import (EngineStats,
+                                                 lint_paths_cached)
+    (tmp_path / "m.py").write_text(textwrap.dedent(HAZARD["m.py"]))
+    cache = tmp_path / ".cache.json"
+    cache.write_text("{ not json")
+    stats = EngineStats()
+    fs = lint_paths_cached([str(tmp_path)], root=str(tmp_path),
+                           cache_file=str(cache), stats=stats)
+    assert [f.rule for f in fs] == ["SYNC001"]
+    assert not stats.cache_loaded
+    assert stats.reanalyzed == stats.total_modules
+
+
+@pytest.mark.slow
+def test_engine_matches_lint_paths_on_repo():
+    """Repo-scale equivalence pin: the incremental engine and the
+    per-family path agree byte-for-byte on the live tree."""
+    from deepspeed_tpu.tools.lint.engine import lint_paths_cached
+    plain = lint_paths([PKG], root=REPO_ROOT)
+    cached = lint_paths_cached([PKG], root=REPO_ROOT, no_cache=True)
+    assert _render_all(plain).encode() == _render_all(cached).encode()
+
+
+def _git(tmp_path, *argv):
+    import subprocess
+    return subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *argv],
+        cwd=str(tmp_path), capture_output=True, text=True, check=True)
+
+
+def test_cli_changed_filters_report(tmp_path, capsys):
+    """--changed reports only findings in files touched vs HEAD; the
+    committed hazard stays out of the report (but the exit code still
+    reflects what IS reported)."""
+    import shutil
+    if shutil.which("git") is None:  # pragma: no cover
+        pytest.skip("git unavailable")
+    src = tmp_path / "pkg"
+    src.mkdir()
+    (src / "old.py").write_text(textwrap.dedent(HAZARD["m.py"]))
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    (src / "new.py").write_text(textwrap.dedent("""\
+        def train_step(b):
+            return b.item()
+        """))
+    rc = lint_main([str(src), "--root", str(tmp_path), "--no-baseline",
+                    "--no-cache", "--changed"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "new.py" in out and "old.py" not in out
+
+
+def test_cli_changed_without_git_reports_all(tmp_path, capsys):
+    src = tmp_path / "pkg"
+    src.mkdir()
+    (src / "m.py").write_text(textwrap.dedent(HAZARD["m.py"]))
+    rc = lint_main([str(src), "--root", str(tmp_path), "--no-baseline",
+                    "--no-cache", "--changed"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "m.py" in out
+
+
+def test_cli_fix_det002_roundtrip(tmp_path, capsys):
+    """--fix wraps the flagged set expression in sorted(...) and the
+    re-lint comes back clean (exit 0)."""
+    src = tmp_path / "pkg"
+    src.mkdir()
+    (src / "m.py").write_text(textwrap.dedent("""\
+        def order(xs):
+            s = {x for x in xs}
+            return list(s)
+        """))
+    assert lint_main([str(src), "--root", str(tmp_path), "--no-baseline",
+                      "--no-cache"]) == 1
+    capsys.readouterr()
+    rc = lint_main([str(src), "--root", str(tmp_path), "--no-baseline",
+                    "--no-cache", "--fix"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "fixed" in out
+    assert "list(sorted(s))" in (src / "m.py").read_text()
+
+
+def test_fix_drift001_appends_stub_rows(tmp_path):
+    """The DRIFT001 fixer appends TODO stub rows under the marked docs
+    table; the re-lint is DRIFT-clean and a human owns the prose."""
+    from deepspeed_tpu.tools.lint.fixes import apply_fixes
+    fs = run_lint(tmp_path, {
+        "obs.py": """\
+        def setup(registry):
+            registry.counter("dstpu_existing_total")
+            registry.gauge("dstpu_new_depth")
+        """,
+        "docs/metrics.md": """\
+        <!-- dstpu-lint: metrics-table -->
+
+        | metric | meaning |
+        |---|---|
+        | `dstpu_existing_total` | covered |
+        """})
+    assert [f.detail for f in fs if f.rule == "DRIFT001"] == \
+        ["dstpu_new_depth"]
+    counts = apply_fixes(str(tmp_path), fs)
+    assert counts == {"docs/metrics.md": 1}
+    text = (tmp_path / "docs" / "metrics.md").read_text()
+    assert "| `dstpu_new_depth` |" in text and "_TODO" in text
+    fs2 = lint_paths([str(tmp_path)], root=str(tmp_path))
+    assert [f for f in fs2 if f.rule.startswith("DRIFT")] == []
+
+
+def test_fix_drift001_declines_without_marker(tmp_path):
+    from deepspeed_tpu.tools.lint.fixes import apply_fixes
+    fs = run_lint(tmp_path, {
+        "obs.py": """\
+        def setup(registry):
+            registry.counter("dstpu_existing_total")
+            registry.gauge("dstpu_new_depth")
+        """,
+        "docs/metrics.md": """\
+        No fixer marker anywhere in this file.
+
+        | metric | meaning |
+        |---|---|
+        | `dstpu_existing_total` | covered |
+        """})
+    assert any(f.rule == "DRIFT001" for f in fs)
+    assert apply_fixes(str(tmp_path), fs) == {}
+
+
+def test_sarif_catalog_covers_v3_rules():
+    """The SARIF rule catalog (and --list-rules) carries the v3 rule
+    ids so forge annotations resolve them."""
+    from deepspeed_tpu.tools.lint.cli import RULE_CATALOG
+    ids = set(RULE_CATALOG)
+    assert {"DET001", "DET002", "DET003", "DET004",
+            "DRIFT001", "DRIFT002", "DRIFT003", "DRIFT004",
+            "FLEET001", "FLEET002"} <= ids
+    assert "LIFE003" not in ids

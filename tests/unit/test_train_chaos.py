@@ -1,0 +1,105 @@
+"""Training-side I/O chaos suite: the checkpoint publish/manifest path
+and the slot-I/O paths (NVMe slot store, infinity .npz slots) replayed
+under an injected-fault schedule.
+
+Runs standalone (empty injector — the clean path) AND under the
+``run_tests.sh`` train-chaos stage, which replays it across the
+``TRAIN_CHAOS_MATRIX`` ``DSTPU_FAULTS`` env matrix — one entry per
+training fault-injection site (``checkpoint.publish``,
+``checkpoint.artifact``, ``slot_store.read``, ``slot_store.write``,
+``infinity.slot_write``, ``infinity.slot_read``; dstpu-lint DRIFT003
+pins that every site stays listed in a matrix). The fixture builds the
+injector FROM the environment, so each matrix entry is the same
+workload under a different fault schedule: transient plans must be
+absorbed by the shared retry policy with data intact, and a fatal plan
+on the publish site must leave 'latest' pointing at the previous
+committed tag (the commit contract of docs/resilience.md).
+"""
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.checkpoint_engine.engine import _publish
+from deepspeed_tpu.runtime.resilience import (
+    FatalIOError, FaultInjector, RetryPolicy, install_fault_injector,
+    verify_manifest)
+from deepspeed_tpu.runtime.swap_tensor.slot_store import NvmeSlotStore
+from deepspeed_tpu.runtime.zero.infinity import (_load_npz_retry,
+                                                 _savez_retry)
+
+pytestmark = [pytest.mark.resilience, pytest.mark.chaos]
+
+#: zero-delay schedule so matrix replays never sleep between retries;
+#: 4 attempts outlasts every transient plan in TRAIN_CHAOS_MATRIX
+FAST = RetryPolicy(max_attempts=4, base_delay_s=0.0, max_delay_s=0.0,
+                   jitter=0.0)
+
+
+@pytest.fixture
+def env_injector():
+    """Install the injector built from DSTPU_FAULTS (empty when unset),
+    so the run_tests.sh fault matrix steers the suite; restored to an
+    empty injector afterwards."""
+    fi = install_fault_injector(FaultInjector.from_env())
+    yield fi
+    install_fault_injector(FaultInjector())
+
+
+def test_checkpoint_publish_commit_is_atomic(env_injector, tmp_path):
+    """Publish a tag with real artifacts under whatever the matrix
+    injects at ``checkpoint.publish`` / ``checkpoint.artifact``: a
+    transient plan is absorbed by the publish retry (meta + manifest are
+    rewritten whole on each attempt), a fatal plan must leave the
+    previous 'latest' untouched — never a torn commit."""
+    tag_dir = tmp_path / "t1"
+    tag_dir.mkdir()
+    (tag_dir / "shard_00.bin").write_bytes(os.urandom(1024))
+    (tag_dir / "shard_01.bin").write_bytes(os.urandom(2048))
+    (tmp_path / "latest").write_text("t0")
+
+    try:
+        _publish(str(tmp_path), "t1", {"step": 1}, None)
+    except FatalIOError:
+        # fatal matrix entry: the commit aborted before 'latest' moved
+        assert (tmp_path / "latest").read_text().strip() == "t0"
+        return
+    assert (tmp_path / "latest").read_text().strip() == "t1"
+    assert (tag_dir / "meta.json").exists()
+    ok, problems = verify_manifest(str(tag_dir))
+    assert ok, problems
+
+
+def test_nvme_slot_store_roundtrip_under_faults(env_injector, tmp_path):
+    """Every slot written through the ``slot_store.write`` site reads
+    back byte-exact through ``slot_store.read`` — transient submit
+    faults land in the shared retry, and the 2-buffer ring forces real
+    disk reads."""
+    st = NvmeSlotStore(4, 512, str(tmp_path / "s.swp"), buffer_count=2)
+    st.io_policy = FAST
+    try:
+        blobs = {
+            s: np.random.RandomState(s).randint(
+                0, 256, 512).astype(np.uint8)
+            for s in range(4)
+        }
+        for s, data in blobs.items():
+            st.write_slot(s, data)
+        st.flush()
+        for s, data in blobs.items():
+            np.testing.assert_array_equal(st.read_slot(s, 512), data)
+    finally:
+        st.close()
+
+
+def test_infinity_slot_io_under_faults(env_injector, tmp_path):
+    """An infinity slot .npz survives its write/read fault sites with
+    data intact: np.savez truncates on retry so a half-written archive
+    from a failed attempt is simply overwritten."""
+    path = str(tmp_path / "slot_00000.npz")
+    p = np.arange(128, dtype=np.float32)
+    m = np.sqrt(p + 1.0)
+    _savez_retry(path, FAST, p=p, m=m)
+    with _load_npz_retry(path, FAST) as z:
+        np.testing.assert_array_equal(z["p"], p)
+        np.testing.assert_array_equal(z["m"], m)
