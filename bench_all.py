@@ -9,6 +9,7 @@ from __future__ import annotations
 import functools
 import json
 import os
+import tempfile
 import time
 
 import numpy as np
@@ -22,6 +23,38 @@ if "xla_force_host_platform_device_count" not in os.environ.get(
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                                " --xla_force_host_platform_device_count=8"
                                ).strip()
+
+
+def _bench_artifact_dir() -> str:
+    """Where serving benches drop their merged fleet trace artifacts
+    (override with DSTPU_BENCH_ARTIFACTS)."""
+    d = os.environ.get("DSTPU_BENCH_ARTIFACTS") or os.path.join(
+        tempfile.gettempdir(), f"dstpu_bench_{os.getpid()}")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _obs_block(art_dir: str) -> dict:
+    """Observability block the serving benches share: tracing +
+    request waterfalls + metrics + the host/device overlap profiler."""
+    return {"tracing": {"enabled": True, "output_dir": art_dir},
+            "request_tracing": {"enabled": True},
+            "metrics": {"enabled": True},
+            "overlap": {"enabled": True}}
+
+
+def _overlap_columns(kind: str = "serving") -> dict:
+    """Host/device overlap summary for the bench JSON line, read from
+    the overlap profiler's registry histograms."""
+    from deepspeed_tpu.observability import get_registry
+    reg = get_registry()
+    h_plan = reg.histogram(f"dstpu_{kind}_host_plan_seconds")
+    h_wait = reg.histogram(f"dstpu_{kind}_device_wait_seconds")
+    h_frac = reg.histogram(f"dstpu_{kind}_overlap_frac_dist")
+    return {"host_plan_ms_p50": round(h_plan.quantile(0.5) * 1e3, 3),
+            "device_wait_ms_p50": round(h_wait.quantile(0.5) * 1e3, 3),
+            "overlap_frac_p50": round(h_frac.quantile(0.5), 4),
+            "iterations": h_frac.count}
 
 
 def train_bench(size: str, micro: int, seq: int, zero_stage: int,
@@ -921,9 +954,11 @@ def multi_tenant_replay_bench(slots: int = 4, new: int = 16,
     from deepspeed_tpu.models import TransformerLM, gpt2_config
 
     cfg = gpt2_config("125m", dtype=jnp.float32, **model_kw)
+    art_dir = _bench_artifact_dir()
     eng = ds.init_inference(TransformerLM(cfg), config={
         "dtype": "float32", "max_out_tokens": 128, "temperature": 0.0,
         "replace_with_kernel_inject": False,
+        "observability": _obs_block(art_dir),
         "serving": {"enabled": True, "kv_block_size": 8,
                     "num_kv_blocks": 64, "max_batch_slots": slots,
                     "prefill_chunk_tokens": 32, "max_queue_depth": 6,
@@ -1024,6 +1059,13 @@ def multi_tenant_replay_bench(slots: int = 4, new: int = 16,
     first_alert_s = round(fired[0][0] - t0, 3) if fired else None
     breach_s = round(p99_breach["at"] - t0, 3) \
         if p99_breach["at"] is not None else None
+    # one merged trace artifact per run: flush the tracer (request
+    # waterfalls + overlap iteration track ride along) and assemble
+    from deepspeed_tpu.observability import FleetTraceAssembler, get_tracer
+    tracer = get_tracer()
+    trace_path = FleetTraceAssembler() \
+        .add_file(tracer.flush(), label=f"rank{tracer.rank}") \
+        .write(os.path.join(art_dir, "multi_tenant_fleet_trace.json"))
     print(json.dumps({
         "metric": "multi_tenant_replay",
         "value": round(sum(pt["tokens"] for pt in per_tenant.values())
@@ -1045,6 +1087,8 @@ def multi_tenant_replay_bench(slots: int = 4, new: int = 16,
             "firing_now": sorted(
                 k for k, v in slo_mon.snapshot().items()
                 if v["state"] == "firing")},
+        "overlap": _overlap_columns("serving"),
+        "fleet_trace": trace_path,
         "decode_builds": srv.decode_builds}), flush=True)
 
 
@@ -1208,10 +1252,13 @@ def disaggregated_fleet_bench(rounds: int = 18, new: int = 10,
     tenants = ("interactive", "batch")
     targets = {"interactive": 0.5, "batch": 1.5}
 
+    art_dir = _bench_artifact_dir()
+
     def build(replicas, prefill_replicas):
         eng = ds.init_inference(TransformerLM(cfg), config={
             "dtype": "float32", "max_out_tokens": 64,
             "temperature": 0.0, "replace_with_kernel_inject": False,
+            "observability": _obs_block(art_dir),
             "serving": {"enabled": True, "kv_block_size": 8,
                         "num_kv_blocks": 64, "max_batch_slots": 4,
                         "prefill_chunk_tokens": 8,
@@ -1308,6 +1355,13 @@ def disaggregated_fleet_bench(rounds: int = 18, new: int = 10,
         decode_chips = max(
             1, sum(r.role != "prefill" for r in fleet.replicas))
         tok_s = sum(len(r.output) for r in reqs) / dt
+        # one merged fleet trace per run: every leg's waterfall under
+        # its fleet trace id, flow arrows chaining the handoffs
+        shape = "split" if split else "uniform"
+        trace_path = fleet.export_fleet_trace(os.path.join(
+            art_dir, f"disagg_fleet_trace_{shape}.json"))
+        fleet.export_fleet_metrics(prometheus_path=os.path.join(
+            art_dir, f"disagg_fleet_{shape}.prom"))
         out = {
             "replicas": [(r.replica_id, r.role) for r in fleet.replicas],
             "decode_tokens_per_sec": round(tok_s, 1),
@@ -1316,6 +1370,8 @@ def disaggregated_fleet_bench(rounds: int = 18, new: int = 10,
             "ttft_p99_ms": {
                 t: round(float(np.percentile(ttft[t], 99)) * 1e3, 2)
                 for t in tenants if ttft[t]},
+            "overlap": _overlap_columns("serving"),
+            "fleet_trace": trace_path,
             "decode_builds": builds}
         if split:
             out["handoffs"] = fleet.fleet_counts["handoffs"]

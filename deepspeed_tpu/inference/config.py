@@ -13,6 +13,7 @@ from typing import Any, Optional
 from pydantic import Field, model_validator
 
 from ..runtime import constants as C
+from ..runtime.config import ObservabilityConfig
 from ..runtime.config_utils import ConfigModel
 
 
@@ -365,6 +366,15 @@ class DeepSpeedInferenceConfig(ConfigModel):
     temperature: float = 1.0
     top_k: int = 0
     top_p: float = 1.0
+    # observability block (same schema as training's
+    # DeepSpeedConfig.observability — runtime/config.py
+    # ObservabilityConfig: tracing/metrics/request_tracing/slo/flight/
+    # overlap).  None (the default) leaves the process-global telemetry
+    # singletons EXACTLY as they are — a serving engine must be able to
+    # join a process whose tracer/registry another engine (or the test
+    # harness) already armed; an explicit block reconfigures them at
+    # engine build, newest-engine-wins like the training path.
+    observability: Optional[ObservabilityConfig] = None
 
     def compute_dtype(self):
         import jax.numpy as jnp

@@ -41,6 +41,16 @@ class InferenceEngine:
                  params: Any = None, mesh: Optional[Mesh] = None):
         self.config = config or DeepSpeedInferenceConfig()
         self.dtype = self.config.compute_dtype()
+        # an explicit observability block arms the process-global
+        # telemetry singletons before the serving stack is built (the
+        # serving engine captures tracer/registry/profiler handles at
+        # construction); None never touches them — an engine may be
+        # joining a process another engine already configured
+        if self.config.observability is not None:
+            from ..observability import configure as _obs_configure
+            import jax as _jax
+            _obs_configure(self.config.observability,
+                           rank=_jax.process_index())
         # int8 x TP composes: TP serving switches the quantizer to
         # per-output-channel scales (see _quantize_weights) whose scale
         # vector shards exactly like the kernel's last axis — no quant

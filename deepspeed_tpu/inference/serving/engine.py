@@ -43,15 +43,15 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ...observability import (get_flight_recorder, get_registry,
-                              get_request_tracer, trace_span)
+from ...observability import (get_flight_recorder, get_overlap_profiler,
+                              get_registry, get_request_tracer, trace_span)
 from ...parallel import topology as topo
 from ...parallel.shard_map_compat import shard_map
 from ...runtime.resilience.errors import (FatalIOError, ServingError,
@@ -186,6 +186,10 @@ class ServingEngine:
         # ``.enabled`` so the disabled default is one attribute check
         self._rt = get_request_tracer()
         self._fr = get_flight_recorder()
+        # host/device overlap profiler (observability/overlap.py): the
+        # iteration bracket + per-dispatch enqueue/wait split below all
+        # guard on ``.enabled`` — disabled is one attribute check
+        self._ovl = get_overlap_profiler()
         # -- (data, model) serving submesh (docs/serving.md
         # "Tensor-parallel serving"): model shards heads + KV pool +
         # MLP, data shards the decode slots; 1x1 keeps the legacy
@@ -362,6 +366,11 @@ class ServingEngine:
             "dstpu_serving_inter_token_seconds",
             "decode-iteration wall time (per-token latency of every "
             "active stream)")
+        #: extra histograms that mirror every TTFT/ITL observation —
+        #: fleet replica handles register their per-replica ground-truth
+        #: series here (observability/fleet_metrics.py merges them
+        #: bucket-wise into the fleet view)
+        self.mirror_hists: Dict[str, List[Any]] = {}
         self._m_tokens = reg.counter(
             "dstpu_serving_tokens_total", "tokens generated by serving")
         self._m_preempt = reg.counter(
@@ -790,7 +799,18 @@ class ServingEngine:
         router's handoff trigger.  No token is ever sampled or emitted
         on the prefill leg; the decode leg starts its stream at output
         index 0 with the pinned key."""
-        self._publish_chain(req)
+        if self._rt.enabled:
+            # fabric_publish is a fleet flow-arrow anchor: the merged
+            # fleet trace binds the prefill->decode handoff arrow inside
+            # this X segment (observability/fleet_trace.py)
+            t0p = time.perf_counter()
+            published = self._publish_chain(req)
+            self._rt.on_segment(
+                req, "fabric_publish", t0p, time.perf_counter() - t0p,
+                blocks=published,
+                publisher=getattr(self, "publisher_id", None))
+        else:
+            self._publish_chain(req)
         self.fabric_counts["prefill_only_completed"] += 1
         self.scheduler.finish_prefill(slot)
         now = time.perf_counter()
@@ -997,7 +1017,8 @@ class ServingEngine:
                seed: Optional[int] = None,
                on_token: Optional[Callable] = None,
                tenant: str = "default",
-               prefill_only: bool = False) -> Request:
+               prefill_only: bool = False,
+               trace_id: Optional[str] = None) -> Request:
         """Queue a request.  ``deadline_s`` is a TTL from submit, swept
         every ``step()`` whether the request is still WAITING or already
         RUNNING (defaults to ``serving.default_deadline_s``; 0 = none).
@@ -1019,7 +1040,13 @@ class ServingEngine:
         handoff: the prompt's KV is computed (and published to the KV
         fabric when the host tier is attached), NO token is emitted,
         and the stream closes with a tokenless OK terminal event the
-        moment the prefill target lands."""
+        moment the prefill target lands.
+
+        ``trace_id`` carries a fleet-wide trace context into this
+        engine: when set, the request tracer adopts it instead of
+        minting a fresh per-process id, so prefill, decode and failover
+        legs of one disaggregated request share ONE trace id in the
+        merged fleet trace (observability/fleet_trace.py)."""
         if prefill_only and self.host_cache is None:
             raise ValueError(
                 "prefill_only requires the host-tier KV fabric "
@@ -1057,6 +1084,10 @@ class ServingEngine:
                       temperature=temperature, top_k=top_k, top_p=top_p,
                       prng_key=key, on_token=on_token, tenant=tenant,
                       prefill_only=prefill_only)
+        if trace_id is not None:
+            # fleet-minted trace context: set BEFORE scheduler.submit so
+            # the request tracer's on_submit adopts it as-is
+            req.trace_id = trace_id
         self.scheduler.submit(req)
         self._drain_terminal_events()
         self._m_queue.set(self.scheduler.queue_depth)
@@ -1387,7 +1418,9 @@ class ServingEngine:
             c_oidx = len(req.output)
         if self._step_fn is None:
             self._step_fn = self._build_step()
+        ovl_on = self._ovl.enabled
         t0 = time.perf_counter()
+        t_enq = t0
         with contextlib.ExitStack() as spans:
             if dec:
                 spans.enter_context(
@@ -1429,6 +1462,10 @@ class ServingEngine:
                         jnp.asarray(c_slot, jnp.int32),
                         jnp.asarray(c_start, jnp.int32),
                         jnp.asarray(c_len, jnp.int32), *samp_args)
+                if ovl_on:
+                    # dispatch returned, nothing materialized yet: the
+                    # enqueue/device-wait boundary for the overlap split
+                    t_enq = time.perf_counter()
                 emitted = np.asarray(emitted)
                 n_emit = np.asarray(n_emit)
                 spec_fin = np.asarray(spec_fin)
@@ -1443,12 +1480,20 @@ class ServingEngine:
                         jnp.asarray(c_slot, jnp.int32),
                         jnp.asarray(c_start, jnp.int32),
                         jnp.asarray(c_len, jnp.int32), *samp_args)
+                if ovl_on:
+                    t_enq = time.perf_counter()
             nxt = np.asarray(nxt)
             dec_fin = np.asarray(dec_fin)
         # ITL = dispatch wall time only, captured BEFORE the host-side
         # bookkeeping below (commit hashing, finishes, quarantines) so
         # the histogram stays comparable across PRs
         dispatch_dt = time.perf_counter() - t0
+        if ovl_on:
+            # enqueue = t0 -> step_fn return; device-wait = step_fn
+            # return -> np.asarray join — both reusing the dispatch_dt
+            # clock reads, no extra syncs
+            self._ovl.note_dispatch(t_enq - t0,
+                                    dispatch_dt - (t_enq - t0))
         if self._rt.enabled and dec:
             # request-track segments reuse t0/dispatch_dt — no extra
             # clock reads on the hot path
@@ -1513,6 +1558,8 @@ class ServingEngine:
             self._m_itl.observe(dispatch_dt,
                                 exemplar=(dec[0][1].trace_id if dec
                                           else spec[0][1].trace_id))
+            for h in self.mirror_hists.get("itl", ()):
+                h.observe(dispatch_dt)
             if progress:
                 self._m_tokens.inc(progress)
         if chunk is not None:
@@ -1551,6 +1598,9 @@ class ServingEngine:
                         self._m_ttft.observe(
                             req.first_token_time - req.submit_time,
                             exemplar=req.trace_id)
+                        for h in self.mirror_hists.get("ttft", ()):
+                            h.observe(req.first_token_time
+                                      - req.submit_time)
                     if req.done:
                         sched.finish(chunk[0])
         return progress
@@ -1588,6 +1638,8 @@ class ServingEngine:
 
     def _step_impl(self) -> bool:
         sched = self.scheduler
+        if self._ovl.enabled:
+            self._ovl.begin()
         finished_before = len(sched.finished)
         sched.sweep_deadlines()
         # capacity BEFORE admission: running sequences claim their next
@@ -1679,6 +1731,8 @@ class ServingEngine:
                     f"consecutive iterations (zero tokens, zero prefill, "
                     f"zero terminal transitions) — scheduler wedged or "
                     f"every dispatch faulted"))
+        if self._ovl.enabled:
+            self._ovl.end("serving")
         return sched.has_work
 
     def _update_drain_rate(self, n_finished: int) -> None:

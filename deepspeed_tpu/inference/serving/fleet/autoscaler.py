@@ -36,6 +36,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from ....observability import get_registry
+from ....observability.fleet_metrics import FleetMetricsAggregator
 from ....observability.slo import KIND_ITL, KIND_TTFT, SloAlert
 from ....runtime.resilience.errors import (FatalIOError,
                                            TransientIOError)
@@ -67,7 +68,8 @@ class FleetAutoscaler:
                  scale_up_cooldown_s: float = 5.0,
                  scale_down_cooldown_s: float = 30.0,
                  queue_high: float = 8.0, queue_low: float = 1.0,
-                 quiet_s: float = 10.0):
+                 quiet_s: float = 10.0,
+                 aggregator: Optional[FleetMetricsAggregator] = None):
         if chip_budget < 1 or chips_per_replica < 1:
             raise ValueError("chip_budget and chips_per_replica must "
                              "be >= 1")
@@ -80,6 +82,14 @@ class FleetAutoscaler:
         self.router = router
         self.spawn_fn = spawn_fn
         self.clock = clock
+        #: ONE metrics surface for policy and dashboards: the sensor
+        #: path reads per-class queue depth and SLO burn rate from the
+        #: fleet aggregator (refreshed each tick) instead of poking
+        #: replica handles ad hoc — a real FleetRouter shares its own
+        #: aggregator, stub routers get a private one
+        self.aggregator = (aggregator if aggregator is not None
+                           else getattr(router, "aggregator", None)
+                           or FleetMetricsAggregator())
         self.chip_budget = chip_budget
         self.chips_per_replica = chips_per_replica
         self.min_per_class = min_per_class
@@ -161,13 +171,17 @@ class FleetAutoscaler:
         with self._alert_lock:
             alerts, self._alerts = self._alerts, []
         self._retire_idle_drains()
+        # refresh the fleet metrics surface, then read policy inputs
+        # from IT — the same numbers the dashboards see
+        self.aggregator.observe_router(self.router)
         classes = self._classes()
         firing = {self._kind_class(a.kind, classes) for a in alerts}
         before = len(self.events)
         for role in classes:
-            healthy = self._healthy(role)
-            depth = sum(r.queue_depth for r in healthy)
-            per_replica = depth / max(1, len(healthy))
+            depth = self.aggregator.class_queue_depth(
+                role, healthy_only=True)
+            n = self.aggregator.class_replicas(role, healthy_only=True)
+            per_replica = depth / max(1, n)
             busy = role in firing or per_replica > self.queue_low
             if busy:
                 self._last_busy[role] = now

@@ -77,6 +77,10 @@ class SubmitSpec:
     tenant: str = "default"
     on_token: Optional[Callable] = None
     key_override: Optional[Tuple[int, int]] = None
+    #: fleet-wide trace context (observability/fleet_trace.py): minted
+    #: once by the router and carried into EVERY leg's engine submit, so
+    #: prefill, decode and failover-replay timelines share one trace id
+    trace_id: Optional[str] = None
     #: fn(engine Request) — the router's bookkeeping tap, called right
     #: after the engine accepts (NOT called for a submit-time shed:
     #: the shed's tokenless terminal event already reached on_token)
@@ -123,6 +127,21 @@ class ReplicaHandle:
             tenant_metric_name("dstpu_fleet_replica", replica_id,
                                "queue_depth"),
             "requests waiting on this fleet replica")
+        # per-replica latency histograms: the GROUND TRUTH the fleet
+        # aggregator's bucket-wise merge is checked against.  The engine
+        # mirrors every TTFT/ITL observation it makes into these.
+        self._m_ttft = reg.histogram(
+            tenant_metric_name("dstpu_fleet_replica", replica_id,
+                               "ttft_seconds"),
+            "time to first token on this fleet replica")
+        self._m_itl = reg.histogram(
+            tenant_metric_name("dstpu_fleet_replica", replica_id,
+                               "itl_seconds"),
+            "inter-token latency on this fleet replica")
+        mirrors = getattr(self.srv, "mirror_hists", None)
+        if mirrors is not None:
+            mirrors.setdefault("ttft", []).append(self._m_ttft)
+            mirrors.setdefault("itl", []).append(self._m_itl)
         self._publish_gauges()
 
     # -- introspection -----------------------------------------------------
@@ -209,9 +228,17 @@ class ReplicaHandle:
         self.state = ReplicaState.DEAD
         self.death_reason = reason
         if self._fr.enabled:
+            in_flight = self.in_flight()
+            self._fr.note_fleet_event({
+                "fleet_event": "replica_dead",
+                "replica": self.replica_id, "reason": reason})
             self._fr.dump("replica_dead", reason, extra={
                 "replica": self.replica_id,
-                "in_flight": [r.req_id for r in self.in_flight()]})
+                "in_flight": [r.req_id for r in in_flight],
+                # per-request trace context: the bundle names the SAME
+                # trace ids the router's failover replay resubmits, so a
+                # post-mortem links straight into the merged fleet trace
+                "trace_ids": {r.req_id: r.trace_id for r in in_flight}})
         self._publish_gauges()
         self._stop.set()
 
@@ -244,7 +271,8 @@ class ReplicaHandle:
             eos_token_id=spec.eos_token_id, deadline_s=spec.deadline_s,
             temperature=spec.temperature, top_k=spec.top_k,
             top_p=spec.top_p, seed=spec.seed, on_token=spec.on_token,
-            tenant=spec.tenant, prefill_only=spec.prefill_only)
+            tenant=spec.tenant, prefill_only=spec.prefill_only,
+            trace_id=spec.trace_id)
         if req.status is not None:
             # shed at submit: the tokenless terminal event already
             # reached on_token inside submit() — nothing to record
@@ -326,3 +354,30 @@ class ReplicaHandle:
     def _publish_gauges(self) -> None:
         self._m_healthy.set(1 if self.routable else 0)
         self._m_queue.set(self.srv.scheduler.queue_depth)
+
+    def metrics_snapshot(self) -> dict:
+        """This replica's registry-snapshot fragment for the
+        ``FleetMetricsAggregator`` — canonical series names (so the
+        merged fleet view keeps them) with THIS replica's values: the
+        aggregator sums/labels scalars and bucket-merges the latency
+        histograms."""
+        from ....observability.fleet_metrics import hist_snapshot
+        srv = self.srv
+        snap = {
+            "dstpu_serving_queue_depth": {
+                "kind": "gauge",
+                "value": float(self.queue_depth)},
+            "dstpu_fleet_replica_up": {
+                "kind": "gauge", "value": 1.0 if self.routable else 0.0},
+            "dstpu_serving_in_flight": {
+                "kind": "gauge", "value": float(len(self.in_flight()))},
+            "dstpu_serving_ttft_seconds": hist_snapshot(self._m_ttft),
+            "dstpu_serving_itl_seconds": hist_snapshot(self._m_itl),
+        }
+        for key, v in getattr(srv, "lifecycle_counts", {}).items():
+            snap[f"dstpu_serving_lifecycle_{key}_total"] = {
+                "kind": "counter", "value": float(v)}
+        for key, v in getattr(srv, "fabric_counts", {}).items():
+            snap[f"dstpu_serving_fabric_{key}_total"] = {
+                "kind": "counter", "value": float(v)}
+        return snap

@@ -39,11 +39,15 @@ to an ordinary cold prefill: never a wrong token, never a stall.
 from __future__ import annotations
 
 import dataclasses
+import itertools
+import os
 import threading
 import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
-from ....observability import (get_flight_recorder, get_registry,
+from ....observability import (FleetMetricsAggregator, FleetTraceAssembler,
+                               FleetTraceContext, get_flight_recorder,
+                               get_registry, get_request_tracer,
                                trace_span)
 from ....runtime.resilience.errors import (FatalIOError, ServingError,
                                            TransientIOError)
@@ -129,6 +133,10 @@ class FleetRequest:
     prefill_done: bool = False
     #: replica id that ran the prefill leg (flight-recorder context)
     prefill_replica_id: Optional[str] = None
+    #: fleet-wide trace id (observability/fleet_trace.py): minted once
+    #: at router submit and carried into EVERY leg's engine submission,
+    #: so all legs stamp their timelines under the same id
+    trace_id: Optional[str] = None
 
     @property
     def output(self) -> List[int]:
@@ -138,6 +146,9 @@ class FleetRequest:
     @property
     def done(self) -> bool:
         return self.status is not None
+
+
+_ROUTER_SEQ = itertools.count()
 
 
 class FleetRouter:
@@ -165,6 +176,12 @@ class FleetRouter:
         self._lock = threading.RLock()
         self._req_counter = 0
         self._fr = get_flight_recorder()
+        self._trace_ctx = FleetTraceContext(
+            origin=f"{next(_ROUTER_SEQ):x}")
+        #: fleet-level metrics view (observability/fleet_metrics.py):
+        #: refreshed on demand (autoscaler tick, exports) — never on the
+        #: pump hot path
+        self.aggregator = FleetMetricsAggregator()
         #: shared host tier (None when host_cache is off) — a joining
         #: replica built against this instance starts warm
         self.shared_host_cache = None
@@ -323,6 +340,11 @@ class FleetRouter:
                 seed=seed, tenant=tenant, on_token=on_token,
                 req_id=f"fleet-{self._req_counter}")
             self._req_counter += 1
+            if get_request_tracer().enabled:
+                # distributed trace context: one fleet-scoped id for
+                # every leg this request will run, minted before the
+                # first placement so even a shed-at-submit is traced
+                freq.trace_id = self._trace_ctx.mint()
             self.requests.append(freq)
             self._try_place(freq)
             return freq
@@ -457,7 +479,8 @@ class FleetRouter:
                 key_override=freq.prng_key,
                 on_submitted=lambda req, f=freq: self._record_submit(
                     f, req),
-                prefill_only=True)
+                prefill_only=True,
+                trace_id=freq.trace_id)
         else:
             spec = SubmitSpec(
                 prompt=freq.prompt, max_new_tokens=freq.max_new_tokens,
@@ -468,7 +491,8 @@ class FleetRouter:
                 on_token=self._make_stream_cb(freq),
                 key_override=freq.prng_key,
                 on_submitted=lambda req, f=freq: self._record_submit(
-                    f, req))
+                    f, req),
+                trace_id=freq.trace_id)
         target.submit(spec)
 
     def _record_submit(self, freq: FleetRequest, req: Request) -> None:
@@ -513,6 +537,11 @@ class FleetRouter:
                 freq.engine_req = None
                 self._m_handoffs.inc()
                 self.fleet_counts["handoffs"] += 1
+                if self._fr.enabled:
+                    self._fr.note_fleet_event({
+                        "fleet_event": "handoff", "req_id": freq.req_id,
+                        "trace_id": freq.trace_id,
+                        "prefill_replica": freq.prefill_replica_id})
                 self._try_place(freq)
             elif ev.status is RequestStatus.SHED:
                 self._absorb_shed(freq, ev.request)
@@ -648,13 +677,17 @@ class FleetRouter:
             victims = [f for f in self.requests
                        if f.status is None and f.replica is dead]
             if self._fr.enabled:
-                self._fr.record({
+                ev = {
                     "t": time.perf_counter(), "fleet_event": "failover",
                     "replica": dead.replica_id,
                     "reason": dead.death_reason,
                     "victims": [f.req_id for f in victims],
+                    "trace_ids": {f.req_id: f.trace_id for f in victims},
                     "delivered": {f.req_id: f.deduper.high_water
-                                  for f in victims}})
+                                  for f in victims}}
+                self._fr.record(ev)
+                self._fr.note_fleet_event(ev)
+            rt = get_request_tracer()
             for f in victims:
                 with trace_span(
                         "fleet/failover", request=f.req_id,
@@ -678,6 +711,14 @@ class FleetRouter:
                     get_registry().counter(
                         "dstpu_io_retries_total").inc()
                     self._try_place(f)
+                    if rt.enabled and f.engine_req is not None:
+                        # anchor the failover-replay leg in the fleet
+                        # trace: the instant lands on the NEW timeline
+                        # (same trace_id, fresh leg)
+                        rt.mark(f.engine_req, "failover_resubmit",
+                                from_replica=dead.replica_id,
+                                delivered=f.deduper.high_water,
+                                attempt=f.failovers)
 
     def run(self, max_pumps: Optional[int] = None
             ) -> List[FleetRequest]:
@@ -725,6 +766,9 @@ class FleetRouter:
         with trace_span("fleet/drain", replica=r.replica_id,
                         in_flight=len(r.in_flight())):
             r.begin_drain()
+        if self._fr.enabled:
+            self._fr.note_fleet_event({
+                "fleet_event": "drain", "replica": r.replica_id})
         self._publish_gauges()
         if pump:
             while r.alive and r.has_work():
@@ -782,6 +826,9 @@ class FleetRouter:
             if self.shared_host_cache is None:
                 self.shared_host_cache = handle.srv.host_cache
             self._m_joins.inc()
+        if self._fr.enabled:
+            self._fr.note_fleet_event({
+                "fleet_event": "join", "replica": handle.replica_id})
         self._publish_gauges()
         return handle
 
@@ -808,3 +855,38 @@ class FleetRouter:
     # -- metrics -----------------------------------------------------------
     def _publish_gauges(self) -> None:
         self._m_routable.set(len(self.routable_replicas))
+
+    def export_fleet_metrics(self, prometheus_path: Optional[str] = None,
+                             json_path: Optional[str] = None
+                             ) -> List[str]:
+        """Refresh the aggregator from every replica handle and write
+        the fleet-level exports (labeled Prometheus textfile and/or JSON
+        snapshot with bucket-merged histograms)."""
+        self.aggregator.observe_router(self)
+        paths: List[str] = []
+        if prometheus_path:
+            paths.append(self.aggregator.export_prometheus(
+                prometheus_path))
+        if json_path:
+            paths.append(self.aggregator.export_json(json_path))
+        return paths
+
+    # -- fleet trace -------------------------------------------------------
+    def export_fleet_trace(self, path: Optional[str] = None,
+                           extra_sources: Sequence[str] = ()) -> str:
+        """Flush the process tracer and write the MERGED fleet trace:
+        every leg of every fleet request under its single trace id, with
+        flow arrows chaining prefill → fabric publish → claim/promote →
+        decode → failover replay (observability/fleet_trace.py).
+        ``extra_sources`` merges additional per-process trace files
+        (multi-process fleets) onto disjoint pid ranges."""
+        from ....observability import get_tracer
+        tracer = get_tracer()
+        src = tracer.flush()
+        asm = FleetTraceAssembler()
+        asm.add_file(src, label=f"rank{tracer.rank}")
+        for extra in extra_sources:
+            asm.add_file(extra)
+        if path is None:
+            path = os.path.join(tracer.output_dir, "fleet_trace.json")
+        return asm.write(path)

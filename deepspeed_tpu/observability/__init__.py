@@ -27,9 +27,14 @@ import atexit
 import os
 from typing import Any, List, Optional, Tuple
 
+from .fleet_metrics import FleetMetricsAggregator  # noqa: F401
+from .fleet_trace import (FleetTraceAssembler,  # noqa: F401
+                          FleetTraceContext, validate_fleet_trace)
 from .flight_recorder import FlightRecorder, get_flight_recorder  # noqa: F401
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
-                      sanitize_name, tenant_metric_name)
+                      interpolate_quantile, sanitize_name,
+                      tenant_metric_name)
+from .overlap import OverlapProfiler, get_overlap_profiler  # noqa: F401
 from .request_trace import (RequestTraceRecorder,  # noqa: F401
                             get_request_tracer)
 from .slo import SloAlert, SloMonitor  # noqa: F401
@@ -107,11 +112,13 @@ def configure(obs_config: Any = None, rank: int = 0
     from . import slo as _slo_mod
     _rt = get_request_tracer()
     _fr = get_flight_recorder()
+    _ovl = get_overlap_profiler()
     if obs_config is None:
         _tracer.configure(enabled=False)
         _registry.enabled = False
         _rt.configure(enabled=False)
         _fr.configure(enabled=False)
+        _ovl.configure(enabled=False)
         _slo_mod.set_defaults(enabled=False)
         return _tracer, _registry
     tr = obs_config.tracing
@@ -148,6 +155,15 @@ def configure(obs_config: Any = None, rank: int = 0
             min_samples=slo_cfg.min_samples)
     else:
         _slo_mod.set_defaults(enabled=False)
+    # host/device overlap profiler: per-iteration host-plan / enqueue /
+    # device-wait split; its iteration track rides the tracer flush
+    ov_cfg = getattr(obs_config, "overlap", None)
+    ov_enabled = bool(ov_cfg is not None and ov_cfg.enabled)
+    _ovl.configure(enabled=ov_enabled,
+                   capacity=ov_cfg.capacity if ov_cfg else None,
+                   rank=rank)
+    _tracer.set_event_source(
+        "overlap", _ovl.chrome_events if ov_enabled else None)
     # flight recorder: bounded snapshot ring + post-mortem bundles
     fl_cfg = getattr(obs_config, "flight", None)
     fl_enabled = bool(fl_cfg is not None and fl_cfg.enabled)

@@ -16,6 +16,9 @@ iteration boundary. On failure it dumps a **post-mortem bundle**:
         metrics.prom      Prometheus textfile at the moment of death
         trace.json        Chrome trace (spans + request waterfalls),
                           when tracing is enabled
+        fleet_events.json fleet lifecycle ring (handoffs, failovers,
+                          drains/joins, replica deaths + the trace ids
+                          of in-flight requests), when a fleet recorded
         manifest.json     content checksums (runtime/resilience integrity)
 
 Every file is written with the atomic-write machinery from
@@ -48,6 +51,9 @@ class FlightRecorder:
         self._ring: List[Optional[Dict[str, Any]]] = []
         self._n = 0                          # total snapshots ever recorded
         self._terminals: deque = deque(maxlen=64)
+        #: fleet lifecycle events (handoff / failover / drain / join /
+        #: replica_dead) — sealed into every bundle as fleet_events.json
+        self._fleet_events: deque = deque(maxlen=64)
         self._lock = threading.Lock()
         self.output_dir = "flight_recorder"
         self.skip_burst_steps = 8
@@ -99,6 +105,15 @@ class FlightRecorder:
         with self._lock:
             self._terminals.append(info)
 
+    def note_fleet_event(self, info: Dict[str, Any]) -> None:
+        """Record one fleet lifecycle event (router/replica sites guard
+        on ``.enabled``); stamped with a timestamp if the caller did not
+        provide one."""
+        if "t" not in info:
+            info = dict(info, t=time.perf_counter())
+        with self._lock:
+            self._fleet_events.append(info)
+
     # -- introspection -----------------------------------------------------
     @property
     def capacity(self) -> int:
@@ -124,11 +139,16 @@ class FlightRecorder:
         with self._lock:
             return list(self._terminals)
 
+    def fleet_events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._fleet_events)
+
     def reset(self) -> None:
         with self._lock:
             self._ring = [None] * self._capacity if self._ring else []
             self._n = 0
             self._terminals.clear()
+            self._fleet_events.clear()
 
     # -- post-mortem -------------------------------------------------------
     def dump(self, reason: str, detail: str = "",
@@ -172,6 +192,13 @@ class FlightRecorder:
         }, indent=2)
         atomic_write_json(os.path.join(bundle, "terminals.json"),
                           self.terminals(), indent=2)
+        fleet_events = self.fleet_events()
+        if fleet_events:
+            # fleet context (when this process hosts a fleet): the event
+            # ring plus the trace ids a post-mortem can chase into the
+            # merged fleet trace
+            atomic_write_json(os.path.join(bundle, "fleet_events.json"),
+                              fleet_events, indent=2)
         from . import get_registry, get_tracer
         reg = get_registry()
         reg.collect()

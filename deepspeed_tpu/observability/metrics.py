@@ -35,6 +35,56 @@ DEFAULT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                    30.0, 60.0)
 
 
+def interpolate_quantile(bounds: Sequence[float],
+                         counts: Sequence[int], q: float) -> float:
+    """The one histogram-quantile estimator (`histogram_quantile()`
+    semantics): linear interpolation inside the bucket that crosses the
+    target rank, with the +inf tail clamped to the highest finite bound.
+
+    ``bounds`` are the finite upper bounds (sorted); ``counts`` are the
+    **per-bucket** (non-cumulative) counts with the +inf tail appended,
+    so ``len(counts) == len(bounds) + 1``.  Shared by ``Histogram``,
+    the textfile ``_p50/_p95/_p99`` companion lines, and the fleet
+    aggregator's bucket-wise merge — one estimator means a merged
+    histogram and its sources can disagree by at most interpolation
+    inside a single bucket, never by estimator drift.
+    """
+    if not (0.0 <= q <= 1.0):
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    target = q * total
+    acc = 0
+    for i, c in enumerate(counts):
+        if acc + c >= target and c > 0:
+            if i >= len(bounds):                    # +inf bucket
+                return float(bounds[-1])
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            return lo + (hi - lo) * (target - acc) / c
+        acc += c
+    return float(bounds[-1])
+
+
+def decumulate(buckets: Sequence[Sequence[Any]]
+               ) -> Tuple[Tuple[float, ...], List[int]]:
+    """Split a ``to_json()``-shaped cumulative bucket list
+    (``[[le | "+Inf", cumulative], ...]``) back into finite bounds and
+    per-bucket counts (with +inf tail) — the inverse of
+    ``Histogram.cumulative()``, used when merging registry *snapshots*
+    rather than live ``Histogram`` objects."""
+    bounds: List[float] = []
+    counts: List[int] = []
+    prev = 0
+    for le, cum in buckets:
+        if not (le == "+Inf" or le == math.inf):
+            bounds.append(float(le))
+        counts.append(int(cum) - prev)
+        prev = int(cum)
+    return tuple(bounds), counts
+
+
 def sanitize_name(name: str) -> str:
     """Map an arbitrary span/op name onto the Prometheus charset.
 
@@ -158,24 +208,42 @@ class Histogram:
         """Estimate the q-quantile by linear interpolation inside the
         bucket bounds (the `histogram_quantile()` estimator): the +inf
         bucket clamps to the highest finite bound, matching Prometheus."""
-        if not (0.0 <= q <= 1.0):
-            raise ValueError(f"quantile must be in [0, 1], got {q}")
         with self._lock:
             counts = list(self._counts)
-            total = self._count
-        if total == 0:
-            return 0.0
-        target = q * total
-        acc = 0
-        for i, c in enumerate(counts):
-            if acc + c >= target and c > 0:
-                if i >= len(self.buckets):          # +inf bucket
-                    return float(self.buckets[-1])
-                lo = self.buckets[i - 1] if i > 0 else 0.0
-                hi = self.buckets[i]
-                return lo + (hi - lo) * (target - acc) / c
-            acc += c
-        return float(self.buckets[-1])
+        return interpolate_quantile(self.buckets, counts, q)
+
+    def merge(self, *others: "Histogram") -> "Histogram":
+        """Bucket-wise merge: a NEW histogram whose per-bucket counts
+        are the element-wise sums of ``self`` and ``others``.
+
+        This is the fleet-aggregation primitive: merging the replicas'
+        bucket counts and interpolating once is exact up to bucket
+        resolution, whereas averaging per-replica quantiles is simply
+        wrong (a p99 is not a mean).  All inputs must share identical
+        bucket bounds — silently resampling mismatched layouts would
+        hide exactly the kind of drift the lint exists to catch."""
+        for o in others:
+            if o.buckets != self.buckets:
+                raise ValueError(
+                    f"cannot merge histogram {o.name!r}: bucket bounds "
+                    f"differ from {self.name!r} ({o.buckets} vs "
+                    f"{self.buckets})")
+        out = Histogram(self.name, help=self.help, buckets=self.buckets)
+        for h in (self,) + others:
+            with h._lock:
+                counts = list(h._counts)
+                s, c = h._sum, h._count
+            for i, n in enumerate(counts):
+                out._counts[i] += n
+            out._sum += s
+            out._count += c
+            ex = h.exemplars()
+            if ex:
+                if out._exemplars is None:
+                    out._exemplars = [None] * len(out._counts)
+                for i, pair in ex.items():
+                    out._exemplars[i] = pair
+        return out
 
     @property
     def count(self) -> int:

@@ -102,6 +102,15 @@ class RequestTraceRecorder:
     def get(self, trace_id: Optional[str]) -> Optional[_Timeline]:
         return self._traces.get(trace_id) if trace_id else None
 
+    def lookup(self, req: Any) -> Optional[_Timeline]:
+        """Timeline for a live request. Prefers the per-leg storage key
+        (``req._trace_key``) over ``req.trace_id`` — under fleet trace
+        propagation several legs (prefill, decode, failover replay)
+        share ONE trace_id but each owns its own timeline."""
+        key = getattr(req, "_trace_key", None) or \
+            getattr(req, "trace_id", None)
+        return self._traces.get(key) if key else None
+
     def reset(self) -> None:
         with self._lock:
             self._traces.clear()
@@ -140,20 +149,36 @@ class RequestTraceRecorder:
 
     # -- lifecycle stamps (call sites guard on ``.enabled``) ---------------
     def on_submit(self, req: Any) -> str:
-        """Assign ``req.trace_id`` and open the ``queued`` phase."""
+        """Assign ``req.trace_id`` and open the ``queued`` phase.
+
+        A trace id already present on the request is HONOURED, not
+        replaced — that is the distributed-trace contract: the fleet
+        router mints one id per fleet request and every leg (prefill
+        worker, decode replica, failover replay) stamps its segments
+        under it. Each leg still gets its own timeline: on a storage-key
+        collision the new leg is filed under ``trace_id#<seq>`` and the
+        request remembers its key in ``req._trace_key``."""
         now = time.perf_counter_ns()
-        trace_id = f"r{self.rank:x}-{next(self._seq):06x}"
+        preset = getattr(req, "trace_id", None)
+        trace_id = preset or f"r{self.rank:x}-{next(self._seq):06x}"
         with self._lock:
+            key = trace_id
+            if key in self._traces:
+                key = f"{trace_id}#{next(self._seq):x}"
             tl = _Timeline(trace_id, req.req_id, req.tenant,
                            next(self._tid_seq))
             self._open_phase(tl, "queued", now)
-            self._traces[trace_id] = tl
+            self._traces[key] = tl
             self._evict_locked()
         req.trace_id = trace_id
+        try:
+            req._trace_key = key
+        except Exception:       # slotted/frozen request objects opt out
+            pass
         return trace_id
 
     def on_admit(self, req: Any, slot: int, cache_hit_tokens: int) -> None:
-        tl = self.get(req.trace_id)
+        tl = self.lookup(req)
         if tl is None:
             return
         now = time.perf_counter_ns()
@@ -167,7 +192,7 @@ class RequestTraceRecorder:
                              now)
 
     def on_preempt(self, req: Any) -> None:
-        tl = self.get(req.trace_id)
+        tl = self.lookup(req)
         if tl is None:
             return
         now = time.perf_counter_ns()
@@ -179,7 +204,7 @@ class RequestTraceRecorder:
 
     def on_prefill_chunk(self, req: Any, t0_s: float, dur_s: float,
                          start: int, tokens: int, done: bool) -> None:
-        tl = self.get(req.trace_id)
+        tl = self.lookup(req)
         if tl is None:
             return
         t0_ns = int(t0_s * 1e9)
@@ -197,7 +222,7 @@ class RequestTraceRecorder:
         dur_ns = int(dur_s * 1e9)
         with self._lock:
             for req in reqs:
-                tl = self._traces.get(req.trace_id) if req.trace_id else None
+                tl = self.lookup(req)
                 if tl is not None:
                     self._append(tl, "X", "decode", t0_ns, dur_ns,
                                  {"batch": batch})
@@ -211,7 +236,7 @@ class RequestTraceRecorder:
         dur_ns = int(dur_s * 1e9)
         with self._lock:
             for req in reqs:
-                tl = self._traces.get(req.trace_id) if req.trace_id else None
+                tl = self.lookup(req)
                 if tl is not None:
                     self._append(tl, "X", "promote", t0_ns, dur_ns,
                                  {"blocks": blocks})
@@ -222,14 +247,27 @@ class RequestTraceRecorder:
         dur_ns = int(dur_s * 1e9)
         with self._lock:
             for req in reqs:
-                tl = self._traces.get(req.trace_id) if req.trace_id else None
+                tl = self.lookup(req)
                 if tl is not None:
                     self._append(tl, "X", "spec_decode", t0_ns, dur_ns,
                                  {"proposed": proposed, "accepted": accepted})
 
+    def on_segment(self, req: Any, name: str, t0_s: float, dur_s: float,
+                   **args: Any) -> None:
+        """Explicit duration segment stamped from timestamps the caller
+        already took (fabric publish window, failover replay window) —
+        these are fleet-trace flow anchors, so they always land even in
+        a segment-capped timeline."""
+        tl = self.lookup(req)
+        if tl is None:
+            return
+        with self._lock:
+            self._append(tl, "X", name, int(t0_s * 1e9), int(dur_s * 1e9),
+                         args or None, force=True)
+
     def mark(self, req: Any, name: str, **args: Any) -> None:
         """Instantaneous event (quarantine, growth-hold, ...)."""
-        tl = self.get(req.trace_id)
+        tl = self.lookup(req)
         if tl is None:
             return
         with self._lock:
@@ -237,7 +275,7 @@ class RequestTraceRecorder:
                          args or None)
 
     def on_terminal(self, req: Any) -> None:
-        tl = self.get(req.trace_id)
+        tl = self.lookup(req)
         if tl is None:
             return
         now = time.perf_counter_ns()

@@ -106,6 +106,7 @@ class SpanTracer:
         self._epoch_ns = time.perf_counter_ns()
         self.rank = 0
         self.output_dir = "traces"
+        self._m_dropped = None     # lazily-bound overflow counter
         # extra flush-time event providers (the request-trace recorder
         # merges its per-request waterfall tracks here) — keyed so
         # re-configuration doesn't stack duplicates. Each provider is
@@ -157,6 +158,18 @@ class SpanTracer:
             rec.tid = tid
             rec.args = args
             self._n += 1
+            overflowed = self._n > self._capacity
+        if overflowed:
+            # ring wraparound just overwrote the oldest span — truncation
+            # must be loud (a trace missing its head is easy to misread
+            # as "nothing happened early")
+            if self._m_dropped is None:
+                from . import get_registry
+                reg = get_registry()
+                self._m_dropped = reg.counter(
+                    "dstpu_trace_dropped_spans_total",
+                    "spans overwritten by trace ring wraparound")
+            self._m_dropped.inc()
 
     # -- introspection -----------------------------------------------------
     @property
@@ -252,6 +265,7 @@ class SpanTracer:
             "displayTimeUnit": "ms",
             "otherData": {"producer": "deepspeed_tpu.observability",
                           "rank": self.rank, "pid": os.getpid(),
+                          "dropped": self.dropped,
                           "dropped_spans": self.dropped},
         }
         tmp = f"{path}.tmp.{os.getpid()}"

@@ -523,6 +523,27 @@ class FlightRecorderConfig(ConfigModel):
         return self
 
 
+class OverlapConfig(ConfigModel):
+    """``observability.overlap`` — host/device overlap profiler
+    (deepspeed_tpu/observability/overlap.py): splits each serving
+    iteration / training step into host-plan, dispatch-enqueue and
+    device-wait from timestamps the engines already take (no new device
+    syncs), exporting overlap gauges+histograms and a per-iteration
+    trace track. The acceptance instrument for the async multi-step
+    scheduler (ROADMAP item 4)."""
+    enabled: bool = C.OBSERVABILITY_OVERLAP_ENABLED_DEFAULT
+    # per-iteration records retained for the trace track
+    capacity: int = C.OBSERVABILITY_OVERLAP_CAPACITY_DEFAULT
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.capacity < 1:
+            raise ValueError(
+                f"observability.overlap.capacity must be >= 1, got "
+                f"{self.capacity}")
+        return self
+
+
 class ObservabilityConfig(ConfigModel):
     """``observability`` block (deepspeed_tpu/observability/,
     docs/observability.md)."""
@@ -533,6 +554,7 @@ class ObservabilityConfig(ConfigModel):
     slo: SloConfig = Field(default_factory=SloConfig)
     flight: FlightRecorderConfig = Field(
         default_factory=FlightRecorderConfig)
+    overlap: OverlapConfig = Field(default_factory=OverlapConfig)
 
     @model_validator(mode="after")
     def _validate(self):
@@ -547,7 +569,7 @@ class ObservabilityConfig(ConfigModel):
     def enabled(self) -> bool:
         return (self.tracing.enabled or self.metrics.enabled
                 or self.request_tracing.enabled or self.slo.enabled
-                or self.flight.enabled)
+                or self.flight.enabled or self.overlap.enabled)
 
 
 #: remat policies the model's ``_remat`` accepts (models/transformer.py);

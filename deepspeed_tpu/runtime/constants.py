@@ -134,6 +134,11 @@ OBSERVABILITY_FLIGHT_DIR_DEFAULT = "flight_recorder"
 OBSERVABILITY_FLIGHT_TERMINALS_DEFAULT = 64     # terminal-event ring
 OBSERVABILITY_FLIGHT_SKIP_BURST_DEFAULT = 8     # skipped-step trigger
 OBSERVABILITY_FLIGHT_MAX_BUNDLES_DEFAULT = 4    # bundles kept per rank
+# host/device overlap profiler (observability/overlap.py): per-iteration
+# host-plan / dispatch-enqueue / device-wait split — the acceptance
+# instrument for the async multi-step scheduler (ROADMAP item 4)
+OBSERVABILITY_OVERLAP_ENABLED_DEFAULT = False
+OBSERVABILITY_OVERLAP_CAPACITY_DEFAULT = 2048   # iteration ring slots
 
 # Serving (continuous batching) block defaults — the ``serving`` block
 # of the INFERENCE config (inference/config.py ServingConfig,

@@ -187,8 +187,12 @@ class DeepSpeedEngine:
         self._analytic_flops_per_step = None
         self._tracer, self._obs = _obs_configure(
             self._config.observability, rank=jax.process_index())
-        from ..observability import get_flight_recorder
+        from ..observability import get_flight_recorder, get_overlap_profiler
         self._flight = get_flight_recorder()
+        # host/device overlap profiler: splits the fused step into
+        # enqueue vs device-wait from timestamps the step path already
+        # takes (observability/overlap.py); disabled = attribute check
+        self._ovl = get_overlap_profiler()
         self._skip_burst = 0
         if self._obs.enabled:
             # derived gauges refreshed at export time (plain host reads —
@@ -999,6 +1003,11 @@ class DeepSpeedEngine:
         with trace_span("engine/train_step", mode="fused",
                         step=self.global_steps):
             self.state, metrics = self._train_step_fn(self.state, batch)
+        ovl_on = self._ovl.enabled
+        # step_fn returned = async dispatch enqueued; the overlap
+        # profiler's enqueue/device-wait boundary (no extra sync — the
+        # wait end reuses the step_sync join below)
+        t_enq = time.perf_counter() if ovl_on else 0.0
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps
         # sync whenever anything CONSUMES the timing (monitor, breakdown,
@@ -1007,10 +1016,19 @@ class DeepSpeedEngine:
         # of magnitude
         sync = (self.monitor.enabled or self._config.wall_clock_breakdown
                 or bool(self._config.steps_per_print) or self._obs.enabled
-                or self._flight.enabled)
+                or self._flight.enabled or self._ovl.enabled)
         if sync:
             with trace_span("engine/step_sync", step=self.global_steps):
                 self.tput_timer.stop(sync=metrics["loss"])
+            if ovl_on:
+                # total = t0 -> after the sync join; wait = enqueue
+                # boundary -> join.  Recorded only on synced steps — an
+                # unsynced step has no join to measure against and the
+                # profiler never adds one
+                t_end = time.perf_counter()
+                self._ovl.observe("train", total_s=t_end - t0,
+                                  enqueue_s=t_enq - t0,
+                                  wait_s=t_end - t_enq)
         else:
             self.tput_timer.stop()
         if self._config.wall_clock_breakdown:

@@ -60,6 +60,7 @@ for f in tests/unit/test_*.py; do
         || "$f" == *test_serving.py || "$f" == *test_serving_tp.py \
         || "$f" == *test_frontend.py || "$f" == *test_host_cache.py \
         || "$f" == *test_fleet.py || "$f" == *test_disagg_fleet.py \
+        || "$f" == *test_fleet_obs.py \
         || "$f" == *test_training_perf.py ]]; then
     continue   # each runs once in its marker sweep below, not twice
   fi
@@ -367,6 +368,51 @@ PYEOF
     PASSED=$((PASSED + 1))
   else
     FAILED+=("flight-recorder post-mortem stage")
+  fi
+fi
+
+# Fleet observability stage: run the fleet-obs suite (including the
+# slow merged-trace e2e — a disaggregated 2-class handoff wave with one
+# forced decode-replica failover, exported via DSTPU_FLEET_OBS_DIR),
+# then re-open the merged Perfetto artifact from a SEPARATE process and
+# re-validate trace continuity + flow-arrow coverage against the JSON
+# alone — the operator's path, not just the in-test assertions
+# (docs/observability.md "Fleet observability & overlap profiling").
+if [[ -z "$FILTER" || "fleet-obs" == *"$FILTER"* \
+      || "observability" == *"$FILTER"* ]]; then
+  echo "=== fleet observability stage (merged trace + metrics plane)"
+  FLEET_OBS_DIR=$(mktemp -d)
+  FLEET_OBS_OK=1
+  DSTPU_FLEET_OBS_DIR="$FLEET_OBS_DIR" JAX_PLATFORMS=cpu python -m pytest \
+       tests/unit/test_fleet_obs.py -q --tb=short \
+       ${EXTRA_PYTEST_ARGS:-} || FLEET_OBS_OK=0
+  if [[ "$FLEET_OBS_OK" == 1 ]]; then
+    DSTPU_FLEET_OBS_DIR="$FLEET_OBS_DIR" JAX_PLATFORMS=cpu \
+        python - <<'PYEOF' || FLEET_OBS_OK=0
+import json, os
+from deepspeed_tpu.observability import validate_fleet_trace
+root = os.environ["DSTPU_FLEET_OBS_DIR"]
+path = os.path.join(root, "fleet_trace.json")
+assert os.path.exists(path), f"no merged fleet trace under {root}"
+doc = json.load(open(path))
+report = validate_fleet_trace(doc)
+assert report, "merged trace names no fleet trace ids"
+multi = {t: r for t, r in report.items() if r["legs"] >= 3}
+assert multi, f"no 3+-leg (prefill/decode/failover) trace: {report}"
+for t, r in multi.items():
+    assert r["flow_events"] >= r["legs"], (t, r)
+prom = open(os.path.join(root, "fleet.prom")).read()
+assert 'fleet_class="decode"' in prom and "_p99" in prom
+legs = max(r["legs"] for r in multi.values())
+print(f"fleet trace OK: {len(report)} trace id(s), "
+      f"deepest chain {legs} legs ({path})")
+PYEOF
+  fi
+  rm -rf "$FLEET_OBS_DIR"
+  if [[ "$FLEET_OBS_OK" == 1 ]]; then
+    PASSED=$((PASSED + 1))
+  else
+    FAILED+=("fleet observability stage")
   fi
 fi
 

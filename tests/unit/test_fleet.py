@@ -37,7 +37,8 @@ from deepspeed_tpu.inference.serving.frontend.streaming import (
     StreamReplayError, TokenEvent)
 from deepspeed_tpu.inference.serving.scheduler import estimate_retry_after_s
 from deepspeed_tpu.models import TransformerLM, gpt2_config
-from deepspeed_tpu.observability import get_flight_recorder
+from deepspeed_tpu.observability import (get_flight_recorder,
+                                         get_request_tracer)
 from deepspeed_tpu.runtime.resilience import (FaultInjector, RetryPolicy,
                                               install_fault_injector)
 from deepspeed_tpu.runtime.resilience.heartbeat import beat
@@ -429,13 +430,18 @@ def test_fleet_failover_token_exact(injector, tmp_path):
     kills r0 mid-wave with staggered in-flight requests; every request
     fails over and still streams token-identical to generate() with
     exactly-once client delivery; the dead replica seals its
-    flight-recorder bundle."""
+    flight-recorder bundle — and the bundle's fleet trace ids are
+    exactly the in-flight set the router resubmits."""
     from deepspeed_tpu.runtime.resilience.integrity import verify_manifest
     injector.add_plan("serving.fleet.replica_step", "fatal", at=5)
     fr = get_flight_recorder()
     fr.configure(enabled=True, capacity=64,
                  output_dir=str(tmp_path / "fr"))
     fr.min_dump_interval_s = 0.0
+    # arm the request tracer so the router mints fleet trace ids — the
+    # post-mortem bundle must name the trace of every victim it strands
+    rt = get_request_tracer()
+    rt.configure(enabled=True, capacity=64)
     try:
         eng = fleet_engine()
         fleet = FleetRouter.from_engine(eng, rng=jax.random.PRNGKey(0))
@@ -473,11 +479,26 @@ def test_fleet_failover_token_exact(injector, tmp_path):
         assert reason["reason"] == "replica_dead"
         assert reason["extra"]["replica"] == "r0"
         assert reason["extra"]["in_flight"], "kill was not mid-wave"
+        # the sealed trace ids ARE the resubmitted set: every request
+        # stranded on r0 (== every request that failed over) appears in
+        # the bundle under its fleet trace id, and nothing else does
+        sealed = reason["extra"]["trace_ids"]
+        assert sealed and all(t and t.startswith("fleet-")
+                              for t in sealed.values()), sealed
+        assert set(sealed.values()) == \
+            {f.trace_id for f in reqs if f.failovers}
+        # the recent fleet-event ring rode along: r0's death is on it
+        with open(os.path.join(bundle, "fleet_events.json")) as fh:
+            fleet_events = json.load(fh)
+        assert any(e.get("fleet_event") == "replica_dead"
+                   and e.get("replica") == "r0" for e in fleet_events)
         # the failover itself is in the snapshot ring for the NEXT dump
         assert any(s.get("fleet_event") == "failover"
                    for s in fr.snapshots() if s)
     finally:
         fr.configure(enabled=False)
+        rt.configure(enabled=False)
+        rt.reset()
 
 
 @pytest.mark.slow
