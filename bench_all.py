@@ -96,6 +96,91 @@ def train_bench(size: str, micro: int, seq: int, zero_stage: int,
         flush=True)
 
 
+def train_3d_bench(size: str = "125m", seq: int = 128,
+                   micro_batches: int = 4, micro: int = 2, iters: int = 3,
+                   shapes=((1, 1, 8), (2, 2, 2), (4, 2, 1)), **cfg_kw):
+    """3D-parallel train sweep over (pp, tp, dp) mesh shapes on one chip
+    budget (docs/training_perf.md "3D parallelism"). Per shape:
+
+      - tokens/s/chip — the comparable throughput number;
+      - measured bubble fraction — the pipeline engine's two-point slope
+        fit over the compiled schedule (pp >= 2 only; the 1F1B number
+        should sit well under gpipe's (S-1)/(M+S-1));
+      - per-chip param+optimizer resident bytes — summed from the placed
+        arrays' actual shard shapes, i.e. what the (pipe, model) param
+        split x ZeRO data sharding really left on one chip;
+      - stage-boundary ppermute volume per step per chip — analytic:
+        every schedule tick rotates one [micro_local, seq, d_model]
+        activation (1F1B also rotates the cotangent), so
+        volume = transfers/step x micro_local x seq x d_model x 2B.
+    """
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import TransformerLM, gpt2_config
+
+    ndev = jax.device_count()
+
+    def _shard_bytes(tree):
+        tot = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            sh = getattr(leaf, "sharding", None)
+            if sh is None or not hasattr(leaf, "shape"):
+                continue
+            tot += int(np.prod(sh.shard_shape(leaf.shape))) * \
+                leaf.dtype.itemsize
+        return tot
+
+    for pp, tp, dp in shapes:
+        name = f"train3d_{size}_pp{pp}_tp{tp}_dp{dp}"
+        if pp * tp * dp != ndev:
+            print(json.dumps({
+                "metric": name, "skipped":
+                f"shape needs {pp * tp * dp} devices, have {ndev}"}),
+                flush=True)
+            continue
+        cfg = gpt2_config(size, max_seq_len=seq, **cfg_kw)
+        model = TransformerLM(cfg)
+        m_count = micro_batches if pp > 1 else 1
+        tb = micro * m_count * dp
+        engine, _, _, _ = ds.initialize(model=model, config={
+            "train_batch_size": tb,
+            "gradient_accumulation_steps": m_count,
+            "optimizer": {"type": "AdamW",
+                          "params": {"lr": 6e-4, "weight_decay": 0.1}},
+            "zero_optimization": {"stage": 1 if dp > 1 else 0},
+            "mesh": {"pipe": pp, "model": tp, "data": dp},
+            "gradient_clipping": 1.0, "steps_per_print": 0},
+            rng=jax.random.PRNGKey(0))
+        rs = np.random.RandomState(0)
+        batch = {"input_ids": rs.randint(0, cfg.vocab_size, (tb, seq),
+                                         dtype=np.int32)}
+        mt = engine.train_step(batch)
+        float(mt["loss"])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            mt = engine.train_step(batch)
+        float(mt["loss"])
+        dt = (time.perf_counter() - t0) / iters
+        row = {"metric": name,
+               "value": round(tb * seq / dt / ndev, 1),
+               "unit": "tokens/s/chip",
+               "loss": round(float(mt["loss"]), 4),
+               "per_chip_state_bytes":
+               _shard_bytes(engine.state.get("params"))
+               + _shard_bytes(engine.state.get("opt"))}
+        if pp > 1:
+            probe = engine.measure_bubble_fraction(repeats=1)
+            row["bubble_frac"] = round(probe["bubble_frac"], 4)
+            row["schedule"] = probe["schedule"]
+            act_bytes = np.dtype(engine.compute_dtype).itemsize
+            transfers = (4 * (m_count + pp - 1)
+                         if engine.schedule == "1f1b"
+                         else 2 * (m_count + pp - 1))
+            row["ppermute_bytes_per_step"] = int(
+                transfers * micro * seq * cfg.d_model * act_bytes)
+        print(json.dumps(row), flush=True)
+
+
 def decode_bench(size: str = "125m", batch: int = 4, prompt: int = 64,
                  new: int = 64):
     import jax
@@ -1432,6 +1517,7 @@ def main():
         train_bench("125m", 64, 1024, 0)
         train_bench("350m", 16, 1024, 2, iters=6)
         train_bench("350m", 16, 1024, 3, iters=6)
+        train_3d_bench("350m", seq=1024, micro=8, iters=4)
         decode_bench()
         hbm = hbm_ceiling_probe()
         decode16k_bench(hbm_gbps=hbm)
@@ -1452,6 +1538,11 @@ def main():
     else:
         train_bench("125m", 2, 128, 0, iters=3, num_layers=4, d_model=256,
                     num_heads=8)
+        # (pp, tp, dp) sweep on the forced 8-device CPU mesh: shape and
+        # bubble-measurement coverage, not absolute throughput
+        import jax.numpy as jnp
+        train_3d_bench(seq=32, micro=1, iters=2, num_layers=4, d_model=32,
+                       num_heads=4, vocab_size=64, dtype=jnp.float32)
         # the (data, model) serving sweep runs on the forced 8-device
         # CPU mesh — mesh-shape coverage, not absolute throughput
         tp_decode_bench()

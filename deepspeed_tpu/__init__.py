@@ -55,10 +55,23 @@ def initialize(args: Any = None,
     if dist_init_required:
         init_distributed()
 
-    engine = DeepSpeedEngine(model=model, config=config, mesh=mesh,
-                             optimizer=optimizer, lr_scheduler=lr_scheduler,
-                             loss_fn=loss_fn, param_specs=param_specs,
-                             rng=rng)
+    # Engine dispatch rides the topology: a mesh whose ``pipe`` axis is
+    # >= 2 — passed in or declared by the config's mesh block (e.g. an
+    # autotuner-exported 3D winner) — trains under the compiled pipeline
+    # schedule; no separate entry point.
+    ds_config = (config if isinstance(config, DeepSpeedConfig)
+                 else DeepSpeedConfig(config or {}))
+    if mesh is None:
+        mesh = build_mesh(ds_config.mesh)
+    from .parallel.topology import pp_world_size
+    engine_cls = DeepSpeedEngine
+    if pp_world_size(mesh) >= 2:
+        from .runtime.pipe.engine import PipelineEngine
+        engine_cls = PipelineEngine
+    engine = engine_cls(model=model, config=ds_config, mesh=mesh,
+                        optimizer=optimizer, lr_scheduler=lr_scheduler,
+                        loss_fn=loss_fn, param_specs=param_specs,
+                        rng=rng)
     dataloader = None
     if training_data is not None:
         dataloader = DeepSpeedDataLoader(

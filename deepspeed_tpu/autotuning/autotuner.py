@@ -14,7 +14,11 @@ Redesign notes:
     index_based/model_based tuners.
   - The space covers the knobs that actually move THIS framework's bench
     (VERDICT r2 weak #7): micro-batch x ZeRO stage x remat policy x
-    loss-chunk x optimizer offload x offload wire-bits x mesh shape. OOM
+    loss-chunk x optimizer offload x offload wire-bits x mesh shape —
+    where mesh shapes may be legacy (data, model) pairs or joint
+    (pipe, model, data) 3D points, pruned by per-chip state bytes
+    (params/dp-shard + optimizer moments + largest remat-window
+    activation), stage divisibility and head/vocab divisibility. OOM
     failures are classified apart from real errors, and an OOM at
     micro-batch m prunes every larger micro-batch of the same
     (stage, remat, chunk, offload, bits, mesh) combination.
@@ -86,9 +90,11 @@ class Autotuner:
         # (zero_optimization.offload_wire_bits): a non-offload run has no
         # wire, so bits there would just duplicate experiments
         self.offload_bits = sorted(set(offload_bits)) or [0]
-        # (data, model) mesh shapes; None entries/default = keep the base
-        # config's mesh. Shapes needing more chips than present are
-        # pruned at generation time, not failed at measure time.
+        # mesh shapes: 2-tuples are (data, model); 3-tuples are
+        # (pipe, model, data) — the joint 3D search. None entries/default
+        # = keep the base config's mesh. Infeasible shapes (device count,
+        # stage/head/vocab divisibility, per-chip state bytes) are pruned
+        # at generation time, not failed at measure time.
         self.mesh_shapes = ([tuple(m) for m in mesh_shapes]
                            if mesh_shapes else [None])
         self.steps_per_trial = steps_per_trial
@@ -110,13 +116,13 @@ class Autotuner:
         if any(m is not None for m in meshes):
             import jax
             ndev = jax.device_count()
-            kept = [m for m in meshes
-                    if m is None or m[0] * m[1] <= ndev]
+            kept = [m for m in meshes if self._mesh_feasible(m, ndev)]
             if len(kept) < len(meshes):
                 logger.info(
                     f"autotune: pruned "
-                    f"{len(meshes) - len(kept)} mesh shape(s) needing "
-                    f"more than {ndev} device(s)")
+                    f"{len(meshes) - len(kept)} infeasible mesh shape(s) "
+                    f"(device count / stage / head / vocab divisibility "
+                    f"on {ndev} device(s))")
             meshes = kept or [None]
         exps = []
         for mb, stage, remat, chunk, (offload, bits), mesh in \
@@ -141,8 +147,20 @@ class Autotuner:
                 cfg["zero_optimization"].pop("offload_optimizer", None)
                 cfg["zero_optimization"].pop("offload_wire_bits", None)
             if mesh is not None:
-                cfg["mesh"] = {**(cfg.get("mesh") or {}),
-                               "data": mesh[0], "model": mesh[1]}
+                m = {**(cfg.get("mesh") or {})}
+                if len(mesh) == 2:
+                    m.update({"data": mesh[0], "model": mesh[1]})
+                else:   # (pipe, model, data): the joint 3D point
+                    m.update({"pipe": mesh[0], "model": mesh[1],
+                              "data": mesh[2]})
+                    if mesh[0] > 1:
+                        # pin the pipeline block so the exported winner
+                        # declares its stage count (ds.initialize
+                        # cross-checks it against the mesh)
+                        pl = dict(cfg.get("pipeline") or {})
+                        pl.setdefault("stages", mesh[0])
+                        cfg["pipeline"] = pl
+                cfg["mesh"] = m
             model_kw = {}
             if remat is not None:
                 model_kw["remat"] = remat
@@ -157,36 +175,101 @@ class Autotuner:
                     if self._predict_fits(e["cfg"], e["model_kw"])]
         return exps
 
+    def _mesh_feasible(self, m, ndev: int) -> bool:
+        """Generation-time shape pruning: device count plus the hard
+        divisibility walls a (pipe, model, data) point would hit at engine
+        build (stage count into the layer scan, model shards into heads
+        and vocab) — pruned here so the grid never wastes a measured trial
+        on a config that cannot construct."""
+        if m is None:
+            return True
+        if len(m) == 2:                      # legacy (data, model)
+            return m[0] * m[1] <= ndev
+        pp, tp, dp = m
+        if pp * tp * dp != ndev:
+            # a fully explicit 3D shape must tile the device array exactly
+            return False
+        mcfg = getattr(self.model, "config", None)
+        if mcfg is None:
+            return True
+        layers = getattr(mcfg, "scan_length",
+                         getattr(mcfg, "num_layers", 0)) or 0
+        if pp > 1 and (not layers or layers % pp):
+            return False
+        if tp > 1:
+            if getattr(mcfg, "vocab_size", 0) % tp:
+                return False
+            if getattr(mcfg, "num_heads", 0) % tp:
+                return False
+            kv = getattr(mcfg, "kv_heads", 0) or 0
+            if kv % tp:
+                return False
+        return True
+
+    def per_chip_state_bytes(self, cfg: Dict[str, Any],
+                             model_kw: Optional[Dict[str, Any]] = None
+                             ) -> Optional[int]:
+        """Estimated resident bytes on ONE chip under this config's
+        (pipe, model, data) placement — the quantity the model-based
+        pruner compares to HBM. None when the model has no introspectable
+        config. Terms:
+
+          - compute params: bf16, sharded over pipe (stage slices) and
+            model (TP column/row splits) → ``2n / (pp·tp)``;
+          - f32 master + Adam moments: 12 bytes on the same param shard,
+            further over ``data`` at ZeRO >= 1; zero on-chip when the
+            optimizer is offloaded to host DRAM;
+          - grads: 4 bytes on the param shard, over ``data`` at ZeRO >= 2
+            (reduce-scatter layout);
+          - activations: the largest remat window — with remat only the
+            per-layer block inputs of the layers this chip owns plus one
+            layer's working set stay live; without it ~4 tensors per
+            layer — plus the 1F1B ring of <= pp+1 in-flight
+            stage-boundary buffers when pipelined.
+        """
+        mcfg = getattr(self.model, "config", None)
+        if mcfg is None:
+            return None
+        import jax
+        ndev = max(jax.device_count(), 1)
+        mesh = cfg.get("mesh") or {}
+        pp = max(int(mesh.get("pipe", 1)), 1)
+        tp = max(int(mesh.get("model", 1)), 1)
+        dp = int(mesh.get("data", -1))
+        if dp <= 0:     # -1 absorbs the remaining devices
+            dp = max(ndev // (pp * tp), 1)
+        dp *= max(int(mesh.get("dcn_data", 1)), 1) \
+            * max(int(mesh.get("expert", 1)), 1)
+        n = mcfg.num_params() if hasattr(mcfg, "num_params") else 0
+        n_local = n / (pp * tp)
+        stage = cfg.get("zero_optimization", {}).get("stage", 0)
+        offload = (cfg.get("zero_optimization", {})
+                   .get("offload_optimizer") or {}).get("device") == "cpu"
+        opt = 0 if offload else n_local * 12 / (dp if stage >= 1 else 1)
+        state = n_local * 2 + opt + n_local * 4 / (dp if stage >= 2 else 1)
+        mb = cfg.get("train_micro_batch_size_per_gpu", 1) or 1
+        remat = (model_kw or {}).get("remat", getattr(mcfg, "remat", "none"))
+        layers = max(1, -(-int(getattr(mcfg, "num_layers", 1)) // pp))
+        eff_layers = (layers * 4 if remat in (None, "none") else layers + 4)
+        act_unit = mb * mcfg.max_seq_len * mcfg.d_model * 2
+        acts = act_unit * eff_layers
+        if pp > 1:
+            acts += act_unit * (pp + 1)
+        return int(state + acts)
+
     def _predict_fits(self, cfg: Dict[str, Any],
                       model_kw: Optional[Dict[str, Any]] = None) -> bool:
-        """Cost-model pruning (reference model_based_tuner): param + opt +
-        activation memory estimate against HBM."""
+        """Cost-model pruning (reference model_based_tuner): per-chip
+        param + optimizer + remat-window activation bytes against HBM."""
         if self.hbm_bytes is None:
             import jax
             stats = jax.devices()[0].memory_stats() or {}
             self.hbm_bytes = stats.get("bytes_limit", 16 * 2 ** 30) or \
                 16 * 2 ** 30
-        mcfg = getattr(self.model, "config", None)
-        if mcfg is None:
+        per_chip = self.per_chip_state_bytes(cfg, model_kw)
+        if per_chip is None:
             return True
-        n = mcfg.num_params() if hasattr(mcfg, "num_params") else 0
-        stage = cfg.get("zero_optimization", {}).get("stage", 0)
-        offload = (cfg.get("zero_optimization", {})
-                   .get("offload_optimizer") or {}).get("device") == "cpu"
-        import jax
-        dp = max(jax.device_count(), 1) if stage else 1
-        # bf16 params + f32 master/m/v (sharded by stage>=1, or in host
-        # DRAM when offloaded) + grads
-        opt_bytes = 0 if offload else (n * 12) / (dp if stage >= 1 else 1)
-        state = n * 2 + opt_bytes + n * 4 / (dp if stage >= 2 else 1)
-        mb = cfg.get("train_micro_batch_size_per_gpu", 1)
-        remat = (model_kw or {}).get("remat", getattr(mcfg, "remat", "none"))
-        # no remat: ~4 live tensors per layer; remat keeps ~the per-layer
-        # block inputs plus one layer's working set
-        eff_layers = (mcfg.num_layers * 4 if remat in (None, "none")
-                      else mcfg.num_layers + 4)
-        acts = mb * mcfg.max_seq_len * mcfg.d_model * 2 * eff_layers
-        return (state + acts) * 1.3 < self.hbm_bytes
+        return per_chip * 1.3 < self.hbm_bytes
 
     def _build_model(self, model_kw: Dict[str, Any]):
         if not model_kw:

@@ -331,6 +331,9 @@ class TransformerLM:
         # non-serving paths (generate, training, pipeline) untouched.
         self._tp_axis: Optional[str] = None   # 'model': heads/KV/MLP
         self._dp_axis: Optional[str] = None   # 'data': decode slots
+        # training TP (tp_train_view): swap the raw psum for the
+        # copy_to/reduce_from custom-vjp pair so backward is exact
+        self._tp_exact_bwd: bool = False
         if config.attention_layers:
             if len(config.attention_layers) != config.num_layers:
                 raise ValueError(
@@ -409,6 +412,21 @@ class TransformerLM:
         view.config = local
         view._tp_axis = tp_axis if model_shards > 1 else None
         view._dp_axis = dp_axis
+        return view
+
+    def tp_train_view(self, model_shards: int,
+                      tp_axis: Optional[str]) -> "TransformerLM":
+        """Per-shard view for tensor-parallel TRAINING regions (the 3D
+        pipeline engine): same per-shard head-count seam as
+        :meth:`tp_serving_view`, but the per-layer collective is the
+        conjugate ``copy_to``/``reduce_from`` pair
+        (`parallel/collectives.py`) instead of a raw forward psum, so
+        hand-driven vjp and in-region autodiff both see exact gradients.
+        Row-parallel bias pre-division and the fused-qkv column gather
+        happen inside the training region (where they must sit in the
+        differentiated function), not at engine prep."""
+        view = self.tp_serving_view(model_shards, tp_axis, None)
+        view._tp_exact_bwd = view._tp_axis is not None
         return view
 
     # -- init --------------------------------------------------------------
@@ -1048,28 +1066,43 @@ class TransformerLM:
         # MLP columns are shard-local, so each branch output is a
         # PARTIAL sum over the model axis — `red` is the one per-layer
         # collective (row-parallel out/fc_out biases are pre-divided by
-        # the shard count at engine prep, so the psum restores them
-        # exactly); identity everywhere else.
+        # the shard count, so the psum restores them exactly); identity
+        # everywhere else.  Training TP (tp_train_view) swaps in the
+        # conjugate pair: `red` becomes reduce_from (psum fwd, identity
+        # bwd) and `fin` (copy_to: identity fwd, psum bwd) marks where
+        # the replicated stream enters each shard-local branch, so the
+        # branch input's cotangent is reassembled from per-shard
+        # partials. `fin` is identity on the serving path — forward
+        # behavior there is byte-identical.
         if self._tp_axis is not None:
-            red = lambda u: jax.lax.psum(u, self._tp_axis)  # noqa: E731
+            if self._tp_exact_bwd:
+                from ..parallel.collectives import copy_to, reduce_from
+                red = reduce_from(self._tp_axis)
+                fin = copy_to(self._tp_axis)
+            else:
+                red = lambda u: jax.lax.psum(u, self._tp_axis)  # noqa: E731
+                fin = lambda u: u                               # noqa: E731
         else:
-            red = lambda u: u                               # noqa: E731
+            red = lambda u: u                                   # noqa: E731
+            fin = lambda u: u                                   # noqa: E731
         if c.norm_position == "post":
             # BERT family: ln(x + f(x)); ln1 after attention, ln2 after FFN
-            a, new_cache = self._attention(bp["attn"], x, cache_kv,
+            a, new_cache = self._attention(bp["attn"], fin(x), cache_kv,
                                            positions, window)
             x = norm(bp["ln1"], x + red(a))
-            x = norm(bp["ln2"], x + red(self._mlp(bp["mlp"], x)))
+            x = norm(bp["ln2"], x + red(self._mlp(bp["mlp"], fin(x))))
         elif c.parallel_residual:
-            a, new_cache = self._attention(bp["attn"], norm(bp["ln1"], x),
+            a, new_cache = self._attention(bp["attn"],
+                                           fin(norm(bp["ln1"], x)),
                                            cache_kv, positions, window)
-            m = self._mlp(bp["mlp"], norm(bp["ln2"], x))
+            m = self._mlp(bp["mlp"], fin(norm(bp["ln2"], x)))
             x = x + red(a + m)
         else:
-            a, new_cache = self._attention(bp["attn"], norm(bp["ln1"], x),
+            a, new_cache = self._attention(bp["attn"],
+                                           fin(norm(bp["ln1"], x)),
                                            cache_kv, positions, window)
             x = x + red(a)
-            x = x + red(self._mlp(bp["mlp"], norm(bp["ln2"], x)))
+            x = x + red(self._mlp(bp["mlp"], fin(norm(bp["ln2"], x))))
         return self.constrain(x), new_cache
 
     def _moe_block(self, bp, x, cache_kv=None, positions=None, rng=None,

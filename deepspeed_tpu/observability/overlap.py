@@ -200,6 +200,22 @@ class OverlapProfiler:
             rec.dispatches = dispatches
             self._n += 1
 
+    # -- one-shot (pipeline bubble probe) ----------------------------------
+    def record_bubble(self, frac: float) -> None:
+        """Measured pipeline-bubble fraction (the pipeline engine's
+        ``measure_bubble_fraction`` probe, `runtime/pipe/engine.py`).
+        A gauge, not a histogram: the probe is an explicit profiling
+        call, and the interesting value is the latest fit."""
+        g = self._metrics.get("bubble")
+        if g is None:
+            from . import get_registry
+            g = get_registry().gauge(
+                "dstpu_train_bubble_frac",
+                "measured pipeline bubble fraction (two-point slope fit "
+                "over the compiled schedule)")
+            self._metrics["bubble"] = g
+        g.set(max(0.0, min(1.0, float(frac))))
+
     # -- introspection -----------------------------------------------------
     @property
     def recorded(self) -> int:

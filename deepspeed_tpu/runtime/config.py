@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import json
 from enum import Enum
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Union
 
 from pydantic import Field, model_validator
 
@@ -217,8 +217,34 @@ class MeshConfig(ConfigModel):
 
 
 class PipelineConfig(ConfigModel):
-    """pipeline block (reference: PipelineEngine knobs on the engine config)."""
-    stages: str = "auto"
+    """pipeline block (reference: PipelineEngine knobs on the engine config).
+
+    ``stages`` — "auto" (stage count = the mesh's ``pipe`` axis) or an
+    explicit int the engine cross-checks against the mesh: a tuned config
+    exported for one topology fails loudly on another instead of silently
+    training a different 3D shape. ``micro_batches`` is an alias for the
+    microbatch count M (reconciled into the batch triple as
+    gradient_accumulation_steps — the reference's train_batch =
+    micro * M * dp identity)."""
+    stages: Union[int, str] = C.PIPE_STAGES_DEFAULT
+
+    @model_validator(mode="after")
+    def _check_stages(self):
+        s = self.stages
+        if isinstance(s, str) and s != "auto":
+            if not s.isdigit():
+                raise ValueError(
+                    f"pipeline.stages must be 'auto' or a positive int, "
+                    f"got {s!r}")
+            self.stages = int(s)
+        if isinstance(self.stages, int) and self.stages < 1:
+            raise ValueError(
+                f"pipeline.stages must be >= 1, got {self.stages}")
+        if self.schedule not in C.PIPE_SCHEDULES:
+            raise ValueError(
+                f"pipeline.schedule must be one of {C.PIPE_SCHEDULES}, "
+                f"got {self.schedule!r}")
+        return self
     partition: str = "parameters"  # parameters | uniform | type:regex
     seed_layers: bool = False
     activation_checkpoint_interval: int = 0
@@ -227,7 +253,7 @@ class PipelineConfig(ConfigModel):
     micro_batches: Optional[int] = None
     # compiled-schedule selection: auto = 1F1B for dense models, gpipe for
     # MoE (whose aux-loss plumbing lives in the gpipe loss)
-    schedule: str = "auto"   # auto | 1f1b | gpipe
+    schedule: str = C.PIPE_SCHEDULE_DEFAULT   # auto | 1f1b | gpipe
 
 
 class SequenceParallelConfig(ConfigModel):

@@ -1,0 +1,13 @@
+"""Pipeline-parallel runtime package.
+
+Exports the light bookkeeping surface only: the stage/replica/shard
+grid and the schedule instruction set. ``PipelineEngine`` itself stays
+a deliberate deep import (``runtime.pipe.engine``) — it pulls the full
+training-engine stack, and ``ds.initialize`` already dispatches to it
+whenever the mesh's ``pipe`` axis is >= 2.
+"""
+from . import schedule  # noqa: F401
+from .topology import (PipelineParallelGrid,  # noqa: F401
+                       grid_sizes_from_mesh)
+
+__all__ = ["PipelineParallelGrid", "grid_sizes_from_mesh", "schedule"]

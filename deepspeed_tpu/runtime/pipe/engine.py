@@ -18,12 +18,25 @@ TPU-native redesign: the whole schedule is a single compiled program.
   - tied-weight grad all-reduce = automatic: tied params enter `shard_map`
     replicated over ``pipe``, so its transpose emits the psum
     (reference's _exec_reduce_tied_grads);
-  - DP gradient reduction + ZeRO sharding compose unchanged — the ``data``
-    axis stays an auto axis handled by GSPMD outside the manual ``pipe``
-    collectives.
+  - 3D composition (dense models): the region is manual over the FULL
+    ``(pipe, model, data)`` product. Each stage's forward/backward is a
+    tensor-parallel program over ``model`` (per-shard head counts via
+    ``tp_train_view``, exact gradients via the ``copy_to``/``reduce_from``
+    pair in `parallel/collectives.py`, vocab-parallel embed + CE), the
+    microbatch dim is sharded over the ``data`` product, and gradients
+    leave the region through ONE collective per axis family: stage
+    boundaries ride ``ppermute`` on ``pipe``, per-layer TP psums stay on
+    ``model``, and the DP gradient reduction is a psum — or a ZeRO-2
+    ``psum_scatter`` straight into the policy's grad layout
+    (`zero/sharding.grad_reduce_plan`) — on ``data``. Three collective
+    families, three axes, zero contention.
+  - MoE models keep the previous region (manual over ``pipe`` only,
+    gpipe schedule) byte-for-byte — their expert/data axes stay auto.
 
 Bubble math matches TrainSchedule: M microbatches over S stages run
 M + S - 1 ticks (forward); backward retraces the same ticks in reverse.
+``measure_bubble_fraction`` turns that from arithmetic into a measured
+gauge (``dstpu_train_bubble_frac``) via a two-point slope fit.
 """
 from __future__ import annotations
 
@@ -37,40 +50,66 @@ from jax.sharding import PartitionSpec as P
 
 from ...models import layers as L
 from ...observability import trace_span
+from ...parallel import collectives as C
 from ...parallel import topology as topo
 from ...parallel.shard_map_compat import shard_map
 from ..engine import DeepSpeedEngine, _count_jit_build, global_norm
-from ..zero.sharding import constrain
+from ..zero.sharding import constrain, grad_reduce_plan
 
 
-def chunked_ce(proj, norm_fn, ln_params, y, tok, chunk, onehot):
+def chunked_ce(proj, norm_fn, ln_params, y, tok, chunk, onehot,
+               tp_axis=None):
     """Shared head loss of BOTH pipeline schedules: final norm + chunked
     cross-entropy over `chunk`-token slices (the [mb, chunk, V] logits
     block is the only live vocab tensor). Returns (sum_nll, token_count).
 
     ``proj``: x → logits; ``onehot``: extract the target logit via a
     one-hot product instead of take_along_axis (gathers along a
-    vocab-sharded dim crash the SPMD partitioner under manual axes)."""
+    vocab-sharded dim crash the SPMD partitioner under manual axes).
+
+    ``tp_axis``: vocab-parallel mode for the 3D engine — ``proj`` maps
+    shard-local ``x`` to LOCAL ``[.., V/mp]`` logits and the softmax
+    statistics reduce over the model axis (Megatron's vocab-parallel CE:
+    shard-max via pmax on a stop_gradient'd copy, log-sum-exp and the
+    target logit via ``reduce_from`` so backward stays exact; the
+    one-hot of ``label - lo`` is all-zero off-shard).  The full [.., V]
+    logits tensor never materializes."""
     mb, t = tok.shape
     x = norm_fn(ln_params, y)
     labels = jnp.concatenate([tok[:, 1:], jnp.zeros_like(tok[:, :1])],
                              axis=1)
     mask = jnp.ones((mb, t), jnp.float32).at[:, -1].set(0.0)
     n_chunks = t // chunk
+    if tp_axis is not None:
+        fin = C.copy_to(tp_axis)
+        red = C.reduce_from(tp_axis)
 
     def to_chunks(a):
         return a.reshape(mb, n_chunks, chunk, *a.shape[2:]).swapaxes(0, 1)
 
     def body(carry, xs):
         xc, yc, mc = xs
-        logits = proj(xc)
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        if onehot:
-            tgt = jnp.sum(logits * jax.nn.one_hot(
-                yc, logits.shape[-1], dtype=logits.dtype), -1)
+        if tp_axis is not None:
+            logits = proj(fin(xc))             # local [mb, chunk, V/mp]
+            vloc = logits.shape[-1]
+            lo = jax.lax.axis_index(tp_axis) * vloc
+            # stop_gradient INSIDE the pmax: pmax has no JVP rule, so a
+            # tangent-carrying operand fails to trace
+            m = jax.lax.pmax(
+                jnp.max(jax.lax.stop_gradient(logits), axis=-1), tp_axis)
+            se = jnp.sum(jnp.exp(logits - m[..., None]), axis=-1)
+            lse = m + jnp.log(red(se))
+            tgt = red(jnp.sum(logits * jax.nn.one_hot(
+                yc - lo, vloc, dtype=logits.dtype), -1))
         else:
-            tgt = jnp.take_along_axis(logits, yc[..., None],
-                                      axis=-1)[..., 0]
+            logits = proj(xc)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            if onehot:
+                tgt = jnp.sum(logits * jax.nn.one_hot(
+                    yc, logits.shape[-1], dtype=logits.dtype), -1)
+            else:
+                tgt = jnp.take_along_axis(logits, yc[..., None],
+                                          axis=-1)[..., 0]
         tot, cnt = carry
         return (tot + jnp.sum((lse - tgt) * mc), cnt + jnp.sum(mc)), None
 
@@ -209,6 +248,48 @@ class PipelineEngine(DeepSpeedEngine):
                 "RSample noisy gating has no rng path in the compiled "
                 "pipeline loop yet; use deterministic gating under "
                 "PipelineEngine")
+        ps = config.pipeline.stages
+        if ps != "auto" and int(ps) != self.num_stages:
+            raise ValueError(
+                f"pipeline.stages ({ps}) != mesh pipe axis "
+                f"({self.num_stages}): this config was exported for a "
+                f"different topology")
+        pmb = config.pipeline.micro_batches
+        if pmb:
+            tb, mb, gas = getattr(
+                config, "_user_batch_triple",
+                (config.train_batch_size,
+                 config.train_micro_batch_size_per_gpu,
+                 config.gradient_accumulation_steps))
+            if gas is not None and gas != pmb:
+                raise ValueError(
+                    f"pipeline.micro_batches ({pmb}) conflicts with "
+                    f"gradient_accumulation_steps ({gas})")
+            # micro_batches IS the accumulation count M; rebalance the
+            # batch triple around it (the per-device micro batch
+            # re-derives from train_batch_size when that is pinned)
+            config._user_batch_triple = (
+                tb, None if tb is not None else mb, pmb)
+        # -- 3D region setup (dense models) ----------------------------
+        self._mp = topo.mp_world_size(mesh)
+        dense = not getattr(mcfg, "moe_enabled", False)
+        if dense and dict(mesh.shape).get(topo.EXPERT_AXIS, 1) > 1:
+            raise NotImplementedError(
+                "expert mesh axis > 1 under a dense pipeline model: the "
+                "3D region reduces gradients over (dcn_data, data) only — "
+                "drop the expert axis or use an MoE model")
+        if dense and self._mp > 1:
+            if mcfg.vocab_size % self._mp:
+                raise ValueError(
+                    f"model mesh axis ({self._mp}) must divide vocab_size "
+                    f"({mcfg.vocab_size}) for vocab-parallel embed/CE")
+            # per-shard head-count view with the exact-backward collective
+            # pair armed; raises on indivisible heads
+            self._tview = adapter.model.tp_train_view(
+                self._mp, topo.MODEL_AXIS)
+        else:
+            self._tview = adapter.model
+        self._plan = None            # grad-reduce plan, set at region build
         super().__init__(model=adapter, config=config, mesh=mesh, **kw)
 
     @property
@@ -225,6 +306,122 @@ class PipelineEngine(DeepSpeedEngine):
             return None
         lps = model.config.scan_length // self.num_stages
         return jax.lax.dynamic_slice(wins, (sid * lps,), (lps,))
+
+    # -- 3D region plumbing ------------------------------------------------
+    def _data_axes(self):
+        """Size>1 data-parallel mesh axes, in mesh order (the ``data``
+        leg of the 3D product; expert is guarded off for dense models)."""
+        ms = dict(self.mesh.shape)
+        return tuple(a for a in (topo.DCN_DATA_AXIS, topo.DATA_AXIS)
+                     if ms.get(a, 1) > 1)
+
+    def _dp_prod(self) -> int:
+        ms = dict(self.mesh.shape)
+        return int(np.prod([ms[a] for a in self._data_axes()] or [1]))
+
+    def _region_param_specs(self):
+        """shard_map in_specs for the 3D region: the adapter's partition
+        specs (``pipe`` on the blocks stack dim, ``model`` on the TP
+        dims), with ``model`` stripped from the fused-qkv leaves — the
+        global ``[q|k|v]`` packing cannot tile contiguously over the
+        model axis, so qkv enters REPLICATED and each shard gathers its
+        own permuted columns inside the differentiated region
+        (`collectives.qkv_shard_columns`)."""
+        specs = self.adapter.partition_specs()
+        if self._mp <= 1:
+            return specs
+
+        def strip(path, sp):
+            keys = tuple(getattr(p, "key", None) for p in path)
+            if keys[-2:] in (("qkv", "kernel"), ("qkv", "bias")):
+                return P(*[None if e == topo.MODEL_AXIS else e
+                           for e in sp])
+            return sp
+        return jax.tree_util.tree_map_with_path(
+            strip, specs, is_leaf=lambda x: isinstance(x, P))
+
+    def _qkv_cols(self):
+        """This model shard's fused-qkv column gather (traced row pick)."""
+        c0 = self.adapter.model.config
+        cols = jnp.asarray(C.qkv_shard_columns(
+            c0.num_heads, c0.kv_heads, c0.hdim, self._mp))
+        return cols[jax.lax.axis_index(topo.MODEL_AXIS)]
+
+    def _tp_localize_fn(self, cols):
+        """Block-param localizer applied INSIDE the differentiated
+        functions: fused-qkv column gather (vjp scatters partial grads
+        back into the global layout) and row-parallel bias pre-division
+        (the reduce_from restores the bias exactly). Identity when the
+        model axis is trivial."""
+        if self._mp <= 1:
+            return lambda bl: bl
+        mp = self._mp
+
+        def localize(bl):
+            bl = dict(bl)
+            attn = dict(bl["attn"])
+            qkv = dict(attn["qkv"])
+            qkv["kernel"] = jnp.take(qkv["kernel"], cols, axis=-1)
+            if "bias" in qkv:
+                qkv["bias"] = jnp.take(qkv["bias"], cols, axis=-1)
+            attn["qkv"] = qkv
+            out = dict(attn["out"])
+            if "bias" in out:
+                out["bias"] = out["bias"] / mp
+            attn["out"] = out
+            bl["attn"] = attn
+            mlp = dict(bl["mlp"])
+            fco = dict(mlp["fc_out"])
+            if "bias" in fco:
+                fco["bias"] = fco["bias"] / mp
+            mlp["fc_out"] = fco
+            bl["mlp"] = mlp
+            return bl
+        return localize
+
+    def _tp_embed_fn(self, cfg, t):
+        """Token+position embed for the region. mp>1: vocab-parallel
+        masked take (off-shard rows zeroed, reduce_from over ``model``
+        rejoins the replicated stream — identity backward, so the local
+        table grad is exact); the positional embed adds AFTER the
+        reduction, on the replicated stream (full grads every shard)."""
+        tp = self._mp > 1
+        red = C.reduce_from(topo.MODEL_AXIS) if tp else None
+        onehot = getattr(self.adapter, "use_onehot_embed", False)
+
+        def embed_fn(ep, tok):
+            if tp:
+                emb = ep["embed"]["embedding"].astype(cfg.dtype)
+                vloc = emb.shape[0]
+                lo = jax.lax.axis_index(topo.MODEL_AXIS) * vloc
+                mine = (tok >= lo) & (tok < lo + vloc)
+                x = jnp.take(emb, jnp.where(mine, tok - lo, 0), axis=0)
+                x = red(jnp.where(mine[..., None], x, jnp.zeros_like(x)))
+            else:
+                embed = (L.embedding_apply_onehot if onehot
+                         else L.embedding_apply)
+                x = embed(ep["embed"], tok, cfg.dtype)
+            if cfg.pos_embedding == "learned":
+                pos = jnp.arange(t)[None, :]
+                x = x + L.embedding_apply(ep["pos_embed"], pos, cfg.dtype)
+            return x
+        return embed_fn
+
+    def _grad_exit_reduce(self, grads):
+        """The per-axis exit collectives of the 3D region: one psum over
+        ``model`` for the partial-gradient leaf set, then one psum — or
+        ZeRO-2 ``psum_scatter`` per the precomputed plan — over the data
+        product for every leaf. (``pipe`` reductions stay at the call
+        sites: blocks are pipe-local, embed/head psum over pipe.)"""
+        if self._mp > 1:
+            grads = C.psum_tp_partials(grads, topo.MODEL_AXIS)
+        daxes = self._data_axes()
+        if daxes:
+            plan_sub = {k: self._plan[k] for k in grads}
+            grads = jax.tree_util.tree_map(
+                lambda g, pl: C.reduce_over_data(g, pl, daxes),
+                grads, plan_sub)
+        return grads
 
     # -- the pipeline loss program (runs inside shard_map over 'pipe') -----
     def _pipeline_loss(self, params, ids):
@@ -323,14 +520,111 @@ class PipelineEngine(DeepSpeedEngine):
             loss = loss + cfg.moe_aux_loss_coef * laux
         return loss
 
+    def _pipeline_loss_3d(self, params, ids):
+        """Dense gpipe loss, manual over the ``(pipe, model, data)``
+        product. ids [M, mb_local, T] — microbatch dim sharded over the
+        data product; params are the region-local views of
+        `_region_param_specs` (TP-sharded kernels, replicated qkv).
+
+        Returns the GLOBAL mean token loss, identical on every shard:
+        the loss-sum / token-count pair reduces via ``reduce_from`` over
+        ``(pipe,) + data`` so in-region autodiff sees an identity
+        backward — each shard's grads come out as its exact partial
+        contribution, and `_grad_exit_reduce` assembles them with one
+        collective per axis family. (The raw-psum transpose would
+        over-count by the shard count — masked by AdamW's scale
+        invariance in the MoE path, exposed by SGD.)"""
+        cfg = self.adapter.config
+        model = self._tview
+        tp = self._mp > 1
+        s = self.num_stages
+        sid = jax.lax.axis_index(topo.PIPE_AXIS)
+        m, mb, t = ids.shape
+        blocks_local = jax.tree_util.tree_map(lambda x: x[0],
+                                              params["blocks"])
+        norm = (L.layernorm_apply if cfg.norm_type == "layernorm"
+                else L.rmsnorm_apply)
+        tied = "lm_head" not in params
+
+        embed_raw = self._tp_embed_fn(cfg, t)
+        embed_fn = lambda tok: embed_raw(params, tok)    # noqa: E731
+        localize = self._tp_localize_fn(self._qkv_cols() if tp else None)
+
+        chunk = cfg.loss_chunk if (cfg.loss_chunk and
+                                   t % max(cfg.loss_chunk, 1) == 0 and
+                                   t > cfg.loss_chunk) else t
+
+        def head_loss(y, tok):
+            if tp:
+                def proj(xc):
+                    if tied:
+                        return L.embedding_attend(params["embed"], xc)
+                    return jnp.einsum(
+                        "...d,dv->...v", xc,
+                        params["lm_head"]["kernel"].astype(xc.dtype),
+                        preferred_element_type=jnp.float32)
+            else:
+                def proj(xc):
+                    return model._project(params, xc)
+            return chunked_ce(proj, partial(norm, eps=cfg.layernorm_eps),
+                              params["ln_f"], y, tok, chunk, False,
+                              tp_axis=topo.MODEL_AXIS if tp else None)
+
+        def sb_fn(sp, x, win=None):
+            y, _, _ = model._superblock(localize(sp), x, None, None, None,
+                                        True, win)
+            return y
+        sb = model._remat(sb_fn)
+        win_local = self._stage_windows(model, sid)
+        xs_local = (blocks_local if win_local is None
+                    else (blocks_local, win_local))
+
+        def stage_fn(x):
+            def f(c, xs):
+                sp, win = (xs, None) if win_local is None else xs
+                return sb(sp, c, win), None
+            y, _ = jax.lax.scan(f, x, xs_local)
+            return y
+
+        perm = [(i, (i + 1) % s) for i in range(s)]
+
+        def tick(carry, tt):
+            state, lsum, cnt = carry
+            recv = jax.lax.ppermute(state, topo.PIPE_AXIS, perm)
+            tok_in = ids[jnp.clip(tt, 0, m - 1)]
+            x = jnp.where(sid == 0, embed_fn(tok_in), recv)
+            y = stage_fn(x)
+            tok_out = ids[jnp.clip(tt - (s - 1), 0, m - 1)]
+            # head only where it's real work (see _pipeline_loss); the
+            # predicate depends on the pipe index alone, so the model-
+            # axis collectives inside the branch stay uniform per stage
+            valid = jnp.logical_and(sid == s - 1, tt >= s - 1)
+            ls, ct = jax.lax.cond(
+                valid, lambda: head_loss(y, tok_out),
+                lambda: (jnp.zeros((), jnp.float32),
+                         jnp.zeros((), jnp.float32)))
+            return (y, lsum + ls, cnt + ct), None
+
+        state0 = jnp.zeros((mb, t, cfg.d_model), cfg.dtype)
+        zero = jnp.zeros((), jnp.float32)
+        (_, lsum, cnt), _ = jax.lax.scan(
+            tick, (state0, zero, zero), jnp.arange(m + s - 1))
+        red = C.reduce_from((topo.PIPE_AXIS,) + self._data_axes())
+        return red(lsum) / jnp.maximum(red(cnt), 1.0)
+
     # ------------------------------------------------------------------
     # 1F1B: one compiled scan over combined fwd/bwd ticks
     # ------------------------------------------------------------------
     def _pipeline_value_and_grad(self, params, ids, scale):
-        """Manual over 'pipe'. ids [M, mb, T]; params in compute dtype.
-        Returns (loss summed over microbatches, grads summed over
-        microbatches x ``scale``) — backward is hand-driven jax.vjp per
-        stage, activations bounded by a ring of S+1 stored stage inputs.
+        """Manual over the full ``(pipe, model, data)`` product (dense
+        models — 1F1B rejects MoE at init). ids [M, mb_local, T] with
+        the microbatch dim sharded over the data product; params in
+        compute dtype, per the region specs (`_region_param_specs`).
+        Returns (loss summed over microbatches AND data shards, grads
+        summed the same way x ``scale``) — backward is hand-driven
+        jax.vjp per stage, activations bounded by a ring of S+1 stored
+        stage inputs; each stage body is the tensor-parallel program of
+        ``tp_train_view`` (exact-gradient copy_to/reduce_from seams).
 
         Tick timing (validated against TrainSchedule, test_pipeline.py):
             forward  of microbatch m at stage s: tick 2m + s
@@ -339,7 +633,8 @@ class PipelineEngine(DeepSpeedEngine):
         are consumed exactly one tick after production.
         """
         cfg = self.adapter.config
-        model = self.adapter.model
+        model = self._tview
+        tp = self._mp > 1
         s = self.num_stages
         sid = jax.lax.axis_index(topo.PIPE_AXIS)
         m, mb, t = ids.shape
@@ -359,18 +654,12 @@ class PipelineEngine(DeepSpeedEngine):
                    ("embed" if tied else "lm_head"):
                        params["embed" if tied else "lm_head"]}
 
-        def embed_fn(ep, tok):
-            embed = (L.embedding_apply_onehot if onehot
-                     else L.embedding_apply)
-            x = embed(ep["embed"], tok, cfg.dtype)
-            if cfg.pos_embedding == "learned":
-                pos = jnp.arange(t)[None, :]
-                x = x + L.embedding_apply(ep["pos_embed"], pos, cfg.dtype)
-            return x
-
+        embed_fn = self._tp_embed_fn(cfg, t)
+        localize = self._tp_localize_fn(self._qkv_cols() if tp else None)
         win_local = self._stage_windows(model, sid)
 
         def stage_fn(bl, x):
+            bl = localize(bl)   # inside the vjp: qkv grads scatter back
             def f(c, xs):
                 bp, win = (xs, None) if win_local is None else xs
                 y, _ = model._block(bp, c, None, None, win)
@@ -385,7 +674,9 @@ class PipelineEngine(DeepSpeedEngine):
 
         def head_fn(hp, y, tok):
             """Per-microbatch MEAN CE via the shared chunked_ce head (the
-            gpipe path consumes the same helper as (sum, count))."""
+            gpipe path consumes the same helper as (sum, count)). Under
+            TP the projection is shard-local ([.., V/mp] logits) and
+            chunked_ce runs Megatron's vocab-parallel CE over ``model``."""
             def proj(xc):
                 if tied:
                     return L.embedding_attend(hp["embed"], xc)
@@ -393,7 +684,8 @@ class PipelineEngine(DeepSpeedEngine):
                                   hp["lm_head"]["kernel"].astype(xc.dtype),
                                   preferred_element_type=jnp.float32)
             tot, cnt = chunked_ce(proj, norm, hp["ln_f"], y, tok, chunk,
-                                  onehot)
+                                  onehot,
+                                  tp_axis=topo.MODEL_AXIS if tp else None)
             return tot / jnp.maximum(cnt, 1.0)
 
         perm_f = [(i, (i + 1) % s) for i in range(s)]
@@ -486,7 +778,8 @@ class PipelineEngine(DeepSpeedEngine):
             tick, carry0, jnp.arange(2 * (m + s - 1)))
 
         psum = partial(jax.lax.psum, axis_name=topo.PIPE_AXIS)
-        loss = psum(lsum)                      # last stage only
+        loss = jax.lax.psum(                   # last stage only; summed
+            lsum, (topo.PIPE_AXIS,) + self._data_axes())
         grads = {"blocks": g_bl}               # stays pipe-local
         g_e = jax.tree_util.tree_map(psum, g_e)     # stage 0 only
         g_h = jax.tree_util.tree_map(psum, g_h)     # last stage only
@@ -499,31 +792,7 @@ class PipelineEngine(DeepSpeedEngine):
             grads["lm_head"] = g_h["lm_head"]
         if "pos_embed" in g_e:
             grads["pos_embed"] = g_e["pos_embed"]
-        return loss, grads
-
-    def _build_1f1b_train_step(self):
-        pipe_specs = self.adapter.pipe_specs()
-        grad_out_specs = pipe_specs   # same tree/layout as the params
-        sharded = shard_map(
-            self._pipeline_value_and_grad, mesh=self.mesh,
-            in_specs=(pipe_specs, P(), P()),
-            out_specs=(P(), grad_out_specs),
-            axis_names={topo.PIPE_AXIS})
-        n_micro = float(self.micro_batches)
-
-        def step_fn(state, batch):
-            ids = batch["input_ids"]        # [M, mb, T]
-            scale = self._current_scale(state)
-            loss_sum, grads = sharded(
-                self._cast_for_compute(state["params"]), ids, scale)
-            new_state, metrics = self._apply_grads(state, grads, n_micro)
-            metrics["loss"] = loss_sum / n_micro
-            return new_state, metrics
-
-        with self.mesh:
-            self._train_step_fn = jax.jit(step_fn, donate_argnums=(0,))
-        _count_jit_build()
-        return self._train_step_fn
+        return loss, self._grad_exit_reduce(grads)
 
     def _build_train_step(self):
         # the schedule itself runs inside ONE jitted program (per-tick
@@ -535,48 +804,164 @@ class PipelineEngine(DeepSpeedEngine):
             return self._build_train_step_traced()
 
     def _pipeline_gpipe_value_and_grad(self, params, ids, scale):
-        """Manual over 'pipe'. Autodiff runs INSIDE the region: legacy
-        jax (0.4.x) cannot transpose the shard_map primitive itself
-        (scalar residuals trip ``_SpecError`` in the partial-eval /
-        transpose pipeline), so the gpipe path mirrors 1F1B's structure
-        — grads are taken per stage and the cross-stage contributions
-        of the replicated leaves (embed/head/ln_f) psummed here, while
-        block grads stay pipe-local like the params themselves.
+        """Autodiff runs INSIDE the region: legacy jax (0.4.x) cannot
+        transpose the shard_map primitive itself (scalar residuals trip
+        ``_SpecError`` in the partial-eval / transpose pipeline), so the
+        gpipe path mirrors 1F1B's structure — grads are taken per stage
+        and the cross-stage contributions of the replicated leaves
+        (embed/head/ln_f) psummed here, while block grads stay
+        pipe-local like the params themselves. Dense models run the 3D
+        loss (`_pipeline_loss_3d`, manual over pipe x model x data, exit
+        reductions via `_grad_exit_reduce`); MoE keeps the pipe-only
+        region and loss unchanged.
         fp16: loss is scaled BEFORE autodiff so small grads survive the
         half-precision backward (reference FP16_Optimizer.backward,
         fp16/fused_optimizer.py); the caller divides the loss back out.
         """
+        moe = getattr(self.adapter.config, "moe_enabled", False)
+
         def loss_fn(p):
-            return self._pipeline_loss(self._cast_for_compute(p),
-                                       ids) * scale
+            inner = (self._pipeline_loss if moe else self._pipeline_loss_3d)
+            return inner(self._cast_for_compute(p), ids) * scale
         loss, grads = jax.value_and_grad(loss_fn)(params)
         psum = partial(jax.lax.psum, axis_name=topo.PIPE_AXIS)
         grads = {k: (v if k == "blocks"
                      else jax.tree_util.tree_map(psum, v))
                  for k, v in grads.items()}
+        if not moe:
+            grads = self._grad_exit_reduce(grads)
         return loss, grads
 
-    def _build_train_step_traced(self):
+    def _build_loss_grad_region(self):
+        """The shard_map'd ``(params, ids, scale) -> (loss, grads)``
+        program — shared by the train-step builders and the bubble
+        probe. Dense models get the 3D region (manual over pipe, model
+        and the data product, ZeRO grad plan precomputed); MoE keeps the
+        pipe-only manual region with every other axis left auto."""
+        if getattr(self.adapter.config, "moe_enabled", False):
+            pipe_specs = self.adapter.pipe_specs()
+            return shard_map(
+                self._pipeline_gpipe_value_and_grad, mesh=self.mesh,
+                in_specs=(pipe_specs, P(), P()),
+                out_specs=(P(), pipe_specs),
+                axis_names={topo.PIPE_AXIS})
+        daxes = self._data_axes()
+        region_specs = self._region_param_specs()
+        self._plan, gout = grad_reduce_plan(region_specs, self.grad_specs,
+                                            daxes)
+        ids_spec = (P(None, daxes if len(daxes) > 1 else daxes[0])
+                    if daxes else P())
+        names = {topo.PIPE_AXIS} | set(daxes)
+        if self._mp > 1:
+            names.add(topo.MODEL_AXIS)
         if self.schedule == "1f1b":
-            return self._build_1f1b_train_step()
-        pipe_specs = self.adapter.pipe_specs()
-        sharded = shard_map(
-            self._pipeline_gpipe_value_and_grad, mesh=self.mesh,
-            in_specs=(pipe_specs, P(), P()),
-            out_specs=(P(), pipe_specs),
-            axis_names={topo.PIPE_AXIS})
+            fn = self._pipeline_value_and_grad
+            # 1F1B assembles exactly the head/embed/blocks grads; subset
+            # the out-spec tree to match (tied embeds have no lm_head key)
+            gout = {k: gout[k] for k in
+                    ("blocks", "ln_f", "embed", "lm_head", "pos_embed")
+                    if k in gout}
+        else:
+            fn = self._pipeline_gpipe_value_and_grad
+        return shard_map(
+            fn, mesh=self.mesh,
+            in_specs=(region_specs, ids_spec, P()),
+            out_specs=(P(), gout),
+            axis_names=names)
 
-        def step_fn(state, batch):
-            ids = batch["input_ids"]        # [M, mb, T]
-            scale = self._current_scale(state)
-            loss, grads = sharded(state["params"], ids, scale)
-            grads = jax.tree_util.tree_map(
-                lambda g: g.astype(jnp.float32), grads)
-            new_state, metrics = self._apply_grads(state, grads, 1.0)
-            metrics["loss"] = loss / scale
-            return new_state, metrics
+    def _build_train_step_traced(self):
+        sharded = self._build_loss_grad_region()
+        if self.schedule == "1f1b":
+            # grads and per-micro mean losses are SUMS over microbatches
+            # and data shards — normalize by both
+            n_eff = float(self.micro_batches * self._dp_prod())
+
+            def step_fn(state, batch):
+                ids = batch["input_ids"]        # [M, micro*dp, T]
+                scale = self._current_scale(state)
+                loss_sum, grads = sharded(
+                    self._cast_for_compute(state["params"]), ids, scale)
+                new_state, metrics = self._apply_grads(state, grads, n_eff)
+                metrics["loss"] = loss_sum / n_eff
+                return new_state, metrics
+        else:
+            # gpipe: the loss is already the global mean (normalized
+            # inside the region), so only the fp16 scale divides out
+            def step_fn(state, batch):
+                ids = batch["input_ids"]        # [M, micro*dp, T]
+                scale = self._current_scale(state)
+                loss, grads = sharded(state["params"], ids, scale)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), grads)
+                new_state, metrics = self._apply_grads(state, grads, 1.0)
+                metrics["loss"] = loss / scale
+                return new_state, metrics
 
         with self.mesh:
             self._train_step_fn = jax.jit(step_fn, donate_argnums=(0,))
         _count_jit_build()
         return self._train_step_fn
+
+    # ------------------------------------------------------------------
+    # measured bubble fraction
+    # ------------------------------------------------------------------
+    def measure_bubble_fraction(self, micro_counts=None, repeats: int = 2,
+                                seq_len: Optional[int] = None) -> Dict:
+        """Measure the schedule's pipeline-bubble fraction on this
+        engine's compiled loss+grad program (two-point slope fit).
+
+        Timing the full program at two microbatch counts M1 < M gives
+        the per-microbatch steady-state cost as the slope; the intercept
+        is the fill/drain bubble:
+
+            bubble = (t(M) - M * slope) / t(M)
+
+        1F1B's ticks cond-skip the bubble slots' compute, so its
+        intercept is small; gpipe's fill-drain loop runs every stage on
+        every tick, so its measured fraction lands near the analytic
+        (S-1)/(M+S-1). Records the ``dstpu_train_bubble_frac`` gauge and
+        returns the fit. Device-syncing — a profiling call, not a train
+        step."""
+        m_full = self.micro_batches
+        if micro_counts is not None:
+            m_small, m_full = micro_counts
+        else:
+            m_small = max(1, m_full // 2)
+        if not m_small < m_full:
+            raise ValueError(
+                f"bubble fit needs two distinct microbatch counts, got "
+                f"({m_small}, {m_full}) — run with "
+                f"gradient_accumulation_steps >= 2")
+        import time as _time
+        cfg = self.adapter.config
+        t_len = int(seq_len or cfg.max_seq_len)
+        mb_global = self.train_batch_size // self.micro_batches
+        with trace_span("pipe/bubble_probe", schedule=self.schedule,
+                        stages=self.num_stages, m_small=m_small,
+                        m_full=m_full):
+            region = self._build_loss_grad_region()
+            with self.mesh:
+                probe = jax.jit(region)   # no donation: params are live
+            _count_jit_build()
+            params = self._cast_for_compute(self.state["params"])
+            scale = jnp.asarray(1.0, jnp.float32)
+            times = {}
+            for m in (m_small, m_full):
+                ids = jnp.zeros((m, mb_global, t_len), jnp.int32)
+                jax.block_until_ready(probe(params, ids, scale))  # compile
+                best = float("inf")
+                for _ in range(max(1, repeats)):
+                    t0 = _time.perf_counter()
+                    jax.block_until_ready(probe(params, ids, scale))
+                    best = min(best, _time.perf_counter() - t0)
+                times[m] = best
+            slope = (times[m_full] - times[m_small]) / (m_full - m_small)
+            frac = 0.0
+            if times[m_full] > 0:
+                frac = (times[m_full] - m_full * slope) / times[m_full]
+            frac = min(1.0, max(0.0, frac))
+        self._ovl.record_bubble(frac)
+        return {"bubble_frac": frac, "schedule": self.schedule,
+                "stages": self.num_stages,
+                "micro_counts": (m_small, m_full),
+                "step_time_s": times[m_full], "per_micro_s": max(slope, 0.0)}

@@ -1,0 +1,124 @@
+"""Pipeline-parallel grid: rank bookkeeping over the 3D topology.
+
+Role-equivalent of the reference ``PipelineParallelGrid``
+(`/root/reference/deepspeed/runtime/pipe/topology.py:249`): given the
+(pipe, data, model) process topology, answer "which stage / data replica
+/ model shard is rank r, and which ranks form each communicator group".
+
+TPU-native redesign: at runtime there are no process groups to build —
+the single `jax.sharding.Mesh` (owned by ``parallel/topology.py``, the
+only module that constructs one) already IS the communicator, and the
+compiled 3D region addresses it by axis name (``ppermute`` on ``pipe``,
+``psum`` on ``model``, ``psum_scatter`` on ``data``). What remains
+grid-shaped is the *bookkeeping*: checkpoint reshape, bench reporting,
+and the stage-boundary ring permutation the pipeline engine's docs and
+tests pin. This module therefore consumes an existing mesh (or explicit
+axis sizes) and never constructs one.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ...parallel.topology import (DATA_AXIS, DCN_DATA_AXIS, EXPERT_AXIS,
+                                  MODEL_AXIS, PIPE_AXIS,
+                                  PipeModelDataParallelTopology,
+                                  ProcessTopology)
+
+
+def grid_sizes_from_mesh(mesh) -> Tuple[int, int, int]:
+    """(pipe, data, model) axis sizes of a built mesh; the data leg is
+    the full data-parallel product (dcn_data x data x expert), matching
+    the gradient-reduce axis set of the 3D region."""
+    ms = dict(mesh.shape)
+    dp = (ms.get(DCN_DATA_AXIS, 1) * ms.get(DATA_AXIS, 1)
+          * ms.get(EXPERT_AXIS, 1))
+    return ms.get(PIPE_AXIS, 1), dp, ms.get(MODEL_AXIS, 1)
+
+
+class PipelineParallelGrid:
+    """Stage/replica/shard coordinates over a (pipe, data, model) grid.
+
+    Rank order is the topology's row-major enumeration — the same order
+    `jax.devices()` flattens the mesh axes, so rank r here is device r
+    of the mesh whose sizes built this grid.
+    """
+
+    def __init__(self, topology: Optional[ProcessTopology] = None,
+                 mesh=None):
+        if topology is None:
+            if mesh is None:
+                raise ValueError(
+                    "PipelineParallelGrid needs a topology or a mesh")
+            pp, dp, mp = grid_sizes_from_mesh(mesh)
+            topology = PipeModelDataParallelTopology(pp, dp, mp)
+        self._topo = topology
+        self.pipe_parallel_size = topology.get_dim("pipe") \
+            if "pipe" in topology.axes else 1
+        self.data_parallel_size = topology.get_dim("data") \
+            if "data" in topology.axes else 1
+        self.model_parallel_size = topology.get_dim("model") \
+            if "model" in topology.axes else 1
+
+    @property
+    def topology(self) -> ProcessTopology:
+        return self._topo
+
+    @property
+    def world_size(self) -> int:
+        return self._topo.world_size
+
+    def _coord(self, rank: int) -> Dict[str, int]:
+        return self._topo.get_coord(rank)
+
+    # -- per-rank coordinates (reference get_stage_id / _id family) --------
+    def get_stage_id(self, rank: int) -> int:
+        return self._coord(rank).get("pipe", 0)
+
+    def get_data_parallel_id(self, rank: int) -> int:
+        return self._coord(rank).get("data", 0)
+
+    def get_model_parallel_id(self, rank: int) -> int:
+        return self._coord(rank).get("model", 0)
+
+    def is_first_stage(self, rank: int) -> bool:
+        return self.get_stage_id(rank) == 0
+
+    def is_last_stage(self, rank: int) -> bool:
+        return self.get_stage_id(rank) == self.pipe_parallel_size - 1
+
+    # -- communicator groups (reference p2p/pipe/data group builders) ------
+    def pipe_groups(self) -> List[List[int]]:
+        """Rank groups that differ only along ``pipe`` — each is one
+        pipeline (the ppermute ring's members)."""
+        return self._topo.get_axis_comm_lists("pipe")
+
+    def data_groups(self) -> List[List[int]]:
+        return self._topo.get_axis_comm_lists("data")
+
+    def model_groups(self) -> List[List[int]]:
+        return self._topo.get_axis_comm_lists("model")
+
+    def stage_to_ranks(self, stage: int) -> List[int]:
+        """All ranks holding the given pipeline stage."""
+        return self._topo.get_axis_list("pipe", stage)
+
+    # -- stage-boundary ring ------------------------------------------------
+    def ppermute_ring(self, shift: int = 1) -> List[Tuple[int, int]]:
+        """(src_stage, dst_stage) pairs of the stage-boundary activation
+        rotation — the permutation the compiled schedule hands
+        ``jax.lax.ppermute`` on the ``pipe`` axis each tick."""
+        s = self.pipe_parallel_size
+        return [(i, (i + shift) % s) for i in range(s)]
+
+    def stage_neighbors(self, stage: int) -> Tuple[Optional[int],
+                                                   Optional[int]]:
+        """(prev, next) stage ids along the dataflow; None past the ends
+        (the schedule masks the wrap-around recv at stage 0)."""
+        prev = stage - 1 if stage > 0 else None
+        nxt = stage + 1 if stage < self.pipe_parallel_size - 1 else None
+        return prev, nxt
+
+    def __str__(self):
+        return (f"PipelineParallelGrid(pipe={self.pipe_parallel_size}, "
+                f"data={self.data_parallel_size}, "
+                f"model={self.model_parallel_size})")

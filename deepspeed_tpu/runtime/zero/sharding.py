@@ -100,6 +100,59 @@ def shard_over_axis(spec: Optional[P], shape: Tuple[int, ...], mesh: Mesh,
     return P(*entries)
 
 
+def grad_reduce_plan(region_specs, grad_specs, data_axes: Sequence[str]):
+    """Per-leaf plan for reducing gradients over the data-parallel axis
+    product INSIDE a manual shard_map region (the 3D pipeline engine).
+
+    ``region_specs`` — the region's param entry specs (pipe/model view);
+    ``grad_specs`` — the ZeRO policy's grad spec tree (data axes added
+    for stage >= 2); ``data_axes`` — the size>1 data-parallel axes the
+    region is manual over, in mesh order.
+
+    Returns ``(plan_tree, out_spec_tree)``: plan leaves are ints
+    (``collectives.REDUCE_PSUM`` = all-reduce over the product, ``d >=
+    0`` = reduce-scatter along dim ``d`` — the dim the policy sharded
+    over the data product, so the gradient leaves the region already in
+    its ZeRO-2 layout); out specs are the region specs with the data
+    axes inserted at the scatter dim.  Int leaves (not tuples) so the
+    plan tree zips leaf-for-leaf against the grads tree."""
+    from ...parallel.collectives import REDUCE_PSUM
+    dset = set(data_axes)
+
+    def one(rsp, gsp):
+        ndim = max(len(list(gsp)) if gsp is not None else 0,
+                   len(list(rsp)) if rsp is not None else 0)
+        gentries = _spec_entries(gsp, ndim)
+        out = _spec_entries(rsp, ndim)
+        for d, e in enumerate(gentries):
+            names = (tuple(e) if isinstance(e, (tuple, list))
+                     else ((e,) if e is not None else ()))
+            if dset & set(names):
+                base = out[d]
+                if base is None:
+                    out[d] = (tuple(data_axes) if len(data_axes) > 1
+                              else data_axes[0])
+                else:
+                    bnames = (tuple(base) if isinstance(base, (tuple, list))
+                              else (base,))
+                    out[d] = bnames + tuple(data_axes)
+                return d, P(*out)
+        return REDUCE_PSUM, P(*out)
+
+    pairs = jax.tree_util.tree_map(
+        one, region_specs, grad_specs,
+        is_leaf=lambda x: isinstance(x, P) or x is None)
+    plan = jax.tree_util.tree_map(
+        lambda pr: pr[0], pairs,
+        is_leaf=lambda x: isinstance(x, tuple) and len(x) == 2
+        and isinstance(x[1], P))
+    out_specs = jax.tree_util.tree_map(
+        lambda pr: pr[1], pairs,
+        is_leaf=lambda x: isinstance(x, tuple) and len(x) == 2
+        and isinstance(x[1], P))
+    return plan, out_specs
+
+
 class ZeroShardingPolicy:
     """Derives all spec trees for a ZeRO stage.
 

@@ -60,7 +60,7 @@ for f in tests/unit/test_*.py; do
         || "$f" == *test_serving.py || "$f" == *test_serving_tp.py \
         || "$f" == *test_frontend.py || "$f" == *test_host_cache.py \
         || "$f" == *test_fleet.py || "$f" == *test_disagg_fleet.py \
-        || "$f" == *test_fleet_obs.py \
+        || "$f" == *test_fleet_obs.py || "$f" == *test_parallel3d.py \
         || "$f" == *test_training_perf.py ]]; then
     continue   # each runs once in its marker sweep below, not twice
   fi
@@ -229,6 +229,42 @@ if [[ -z "$FILTER" || "train_chaos" == *"$FILTER"* || "resilience" == *"$FILTER"
       PASSED=$((PASSED + 1))
     else
       FAILED+=("train-chaos [DSTPU_FAULTS=${faults}]")
+    fi
+  done
+fi
+
+# 3D-parallel sweep: the `parallel3d`-marked acceptance suite —
+# pipe x model x data grid bookkeeping, joint (pp, tp, dp) search-space
+# pruning by per-chip state bytes, the (2,2,2) multi-hundred-M e2e
+# train with single-device loss parity, bit-exact checkpoint round-trip
+# across the 3D mesh, the measured 1F1B-vs-gpipe bubble at (4,2,1),
+# and the autotune winner -> DeepSpeedConfig -> ds.initialize
+# round-trip (pytest.ini `parallel3d` marker; docs/training_perf.md
+# "3D parallelism"). The chaos-marked 3D train-step case then replays
+# across its own DSTPU_FAULTS matrix: a transient publish plan (the
+# save commits whole, restore is bit-exact) and a fatal publish plan
+# ('latest' never moves off the previous committed tag even when the
+# torn save happened mid-3D-training).
+if [[ -z "$FILTER" || "parallel3d" == *"$FILTER"* || "training" == *"$FILTER"* ]]; then
+  echo "=== 3D-parallel marker sweep (pytest -m parallel3d)"
+  if JAX_PLATFORMS=cpu python -m pytest tests/unit/test_parallel3d.py \
+       -m parallel3d -q --tb=short ${EXTRA_PYTEST_ARGS:-}; then
+    PASSED=$((PASSED + 1))
+  else
+    FAILED+=("pytest -m parallel3d")
+  fi
+  PARALLEL3D_CHAOS_MATRIX=(
+    "checkpoint.publish=fail:1:2"
+    "checkpoint.publish=fatal:1:1"
+  )
+  for faults in "${PARALLEL3D_CHAOS_MATRIX[@]}"; do
+    echo "=== 3D-parallel chaos sweep (DSTPU_FAULTS='${faults}')"
+    if DSTPU_FAULTS="$faults" JAX_PLATFORMS=cpu python -m pytest \
+         tests/unit/test_parallel3d.py -m chaos -q --tb=short \
+         ${EXTRA_PYTEST_ARGS:-}; then
+      PASSED=$((PASSED + 1))
+    else
+      FAILED+=("parallel3d-chaos [DSTPU_FAULTS=${faults}]")
     fi
   done
 fi

@@ -363,7 +363,10 @@ class TestParamWireCodec:
         assert not np.all(np.isfinite(dec[:wc.CHUNK]))
         assert np.all(np.isfinite(dec[wc.CHUNK:]))
 
-    @pytest.mark.parametrize("bits", [8, 4])
+    # the 4-bit arm re-runs the same ~13s convergence loop at a coarser
+    # codec; the 8-bit arm stays the tier-1 representative
+    @pytest.mark.parametrize("bits", [
+        8, pytest.param(4, marks=pytest.mark.slow)])
     def test_param_wire_training_converges(self, bits):
         """Streamed training with quantized param uploads still memorizes
         the batch; 8-bit stays in a band of the exact-upload trajectory."""

@@ -100,8 +100,10 @@ class TestFusedLossHead:
         val, grads = jax.value_and_grad(model.loss)(params, batch)
         return float(val), grads
 
+    # the tied-head arm is the heaviest (~12s) of the three parity pins;
+    # the untied + chunked arms keep the contract in tier-1
     @pytest.mark.parametrize("kw", [
-        {},                               # tied embedding head
+        pytest.param({}, marks=pytest.mark.slow),  # tied embedding head
         {"tie_embeddings": False},        # untied lm_head kernel
         {"loss_chunk": 8},                # chunked scan path
     ])
